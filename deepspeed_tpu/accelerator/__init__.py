@@ -1,0 +1,4 @@
+from .real_accelerator import get_accelerator, set_accelerator
+from .abstract_accelerator import Accelerator
+
+__all__ = ["get_accelerator", "set_accelerator", "Accelerator"]

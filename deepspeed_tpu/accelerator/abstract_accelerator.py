@@ -1,0 +1,129 @@
+"""Accelerator abstraction.
+
+Capability analogue of the reference's ``accelerator/abstract_accelerator.py``
+(``DeepSpeedAccelerator``, ~80 abstract methods): one interface the whole
+runtime is written against.  On JAX the device model is simpler (no streams/
+events — XLA handles async dispatch), so the surface is the meaningful subset:
+device identity/count, memory stats, synchronization, RNG, dtype support,
+communication-backend name, and the named-op registry (the op-builder role).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional
+
+
+class Accelerator(abc.ABC):
+    """One instance per process; see ``real_accelerator.get_accelerator()``."""
+
+    _name: str = "abstract"
+
+    # --- identity -----------------------------------------------------
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return self._name
+        return f"{self._name}:{device_index}"
+
+    @abc.abstractmethod
+    def platform(self) -> str:
+        """jax platform string: 'tpu' | 'cpu' | 'gpu'."""
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        """Local (process-visible) device count."""
+
+    @abc.abstractmethod
+    def global_device_count(self) -> int:
+        ...
+
+    def is_available(self) -> bool:
+        return self.device_count() > 0
+
+    # --- devices ------------------------------------------------------
+    def devices(self) -> List[Any]:
+        import jax
+
+        return [d for d in jax.local_devices() if d.platform == self.platform()]
+
+    def current_device(self) -> Any:
+        return self.devices()[0]
+
+    # --- sync / memory ------------------------------------------------
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        import jax
+
+        jax.effects_barrier()
+
+    def memory_stats(self, device_index: int = 0) -> Dict[str, int]:
+        try:
+            stats = self.devices()[device_index].memory_stats()
+            return dict(stats or {})
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index: int = 0) -> int:
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index: int = 0) -> int:
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def total_memory(self, device_index: int = 0) -> int:
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index: int = 0) -> int:
+        stats = self.memory_stats(device_index)
+        return stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+
+    def empty_cache(self) -> None:  # XLA manages memory; parity no-op
+        pass
+
+    # --- RNG ----------------------------------------------------------
+    def default_rng(self, seed: int):
+        import jax
+
+        return jax.random.PRNGKey(seed)
+
+    # --- dtype support ------------------------------------------------
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def is_fp8_supported(self) -> bool:
+        return False
+
+    def preferred_dtype(self) -> str:
+        return "bfloat16"
+
+    # --- comm ---------------------------------------------------------
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        """'ici' for intra-slice XLA collectives, 'gloo'-like cpu ring, etc."""
+
+    def supports_dcn(self) -> bool:
+        return False
+
+    # --- ops (op-builder role) ----------------------------------------
+    def create_op_builder(self, op_name: str):
+        from ..ops.op_registry import get_op_builder
+
+        return get_op_builder(op_name, self.platform())
+
+    # --- misc ---------------------------------------------------------
+    def range_push(self, name: str):
+        import jax
+
+        return jax.named_scope(name)
+
+    def range_pop(self) -> None:
+        pass
+
+    def device_kind(self) -> str:
+        devs = self.devices()
+        return devs[0].device_kind if devs else "unknown"
+
+    def peak_tflops(self, dtype: str = "bfloat16") -> float:
+        """Per-chip peak for MFU accounting; override per platform."""
+        return 0.0

@@ -1,0 +1,43 @@
+"""Accelerator selection.
+
+Reference: ``accelerator/real_accelerator.py:52 get_accelerator`` — env-var
+override (``DS_ACCELERATOR``) plus auto-detection, cached per process.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .abstract_accelerator import Accelerator
+
+_accelerator: Optional[Accelerator] = None
+
+
+def set_accelerator(accel: Accelerator) -> None:
+    global _accelerator
+    _accelerator = accel
+
+
+def get_accelerator() -> Accelerator:
+    global _accelerator
+    if _accelerator is not None:
+        return _accelerator
+
+    from .tpu_accelerator import CPUAccelerator, TPUAccelerator
+
+    override = os.environ.get("DSTPU_ACCELERATOR", os.environ.get("DS_ACCELERATOR"))
+    if override == "cpu":
+        _accelerator = CPUAccelerator()
+        return _accelerator
+    if override in ("tpu", "axon"):
+        _accelerator = TPUAccelerator()
+        return _accelerator
+
+    import jax
+
+    if jax.default_backend() == "cpu":
+        _accelerator = CPUAccelerator()
+    else:
+        _accelerator = TPUAccelerator()
+    return _accelerator

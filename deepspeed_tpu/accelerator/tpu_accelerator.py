@@ -1,0 +1,99 @@
+"""TPU accelerator (the native platform) and a CPU fallback for tests.
+
+Reference counterpart: ``accelerator/real_accelerator.py`` +
+``accelerator/cuda_accelerator.py`` — here the real backend is TPU/XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .abstract_accelerator import Accelerator
+
+# Peak dense bf16 TFLOPS per chip, for MFU accounting.
+_TPU_PEAK_TFLOPS = {
+    # device_kind substrings → bf16 peak
+    "v4": 275.0,
+    "v5 lite": 197.0,  # v5e
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,  # trillium
+    "v6e": 918.0,
+}
+
+
+class TPUAccelerator(Accelerator):
+    _name = "tpu"
+
+    def platform(self) -> str:
+        import jax
+
+        # Under the axon tunnel the platform string may differ; treat any
+        # non-cpu/gpu default backend as the TPU-class accelerator.
+        backend = jax.default_backend()
+        return backend if backend not in ("cpu", "gpu") else "tpu"
+
+    def devices(self):
+        import jax
+
+        plat = self.platform()
+        devs = [d for d in jax.local_devices() if d.platform == plat]
+        return devs or list(jax.local_devices())
+
+    def device_count(self) -> int:
+        try:
+            return len(self.devices())
+        except Exception:
+            return 0
+
+    def global_device_count(self) -> int:
+        import jax
+
+        return jax.device_count()
+
+    def communication_backend_name(self) -> str:
+        return "xla-ici"
+
+    def supports_dcn(self) -> bool:
+        return True
+
+    def is_fp8_supported(self) -> bool:
+        # v5p onward have int8/fp8-friendly paths; report conservatively.
+        kind = self.device_kind().lower()
+        return any(k in kind for k in ("v5p", "v6"))
+
+    def peak_tflops(self, dtype: str = "bfloat16") -> float:
+        kind = self.device_kind().lower()
+        for key, tflops in _TPU_PEAK_TFLOPS.items():
+            if key in kind:
+                return tflops * (2.0 if dtype in ("int8", "fp8") else 1.0)
+        return 197.0  # default to v5e
+
+
+class CPUAccelerator(Accelerator):
+    """Host-CPU backend — used by the unit-test mesh
+    (``--xla_force_host_platform_device_count=N``) and by offload targets."""
+
+    _name = "cpu"
+
+    def platform(self) -> str:
+        return "cpu"
+
+    def device_count(self) -> int:
+        import jax
+
+        return len([d for d in jax.local_devices() if d.platform == "cpu"])
+
+    def global_device_count(self) -> int:
+        import jax
+
+        return len([d for d in jax.devices() if d.platform == "cpu"])
+
+    def communication_backend_name(self) -> str:
+        return "xla-host"
+
+    def preferred_dtype(self) -> str:
+        return "float32"
+
+    def peak_tflops(self, dtype: str = "bfloat16") -> float:
+        return 1.0

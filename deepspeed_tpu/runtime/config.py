@@ -1,0 +1,532 @@
+"""The framework config tree.
+
+A single JSON/dict config — same spine role and largely the same keys as the
+reference's ``deepspeed/runtime/config.py`` (``DeepSpeedConfig``,
+``runtime/zero/config.py``, ``runtime/config_utils.py``) — parsed into a typed
+pydantic tree.  TPU-specific extensions live under ``"mesh"`` (device-mesh axis
+sizes), ``"remat"`` (rematerialisation policy) and precision handling prefers
+bf16 (fp16 + dynamic loss scaling is kept for capability parity).
+
+Batch-size arithmetic follows the reference contract
+(``runtime/config.py`` `_configure_train_batch_size`):
+
+    train_batch_size == micro_batch_per_device * gradient_accumulation_steps
+                        * data_parallel_world_size
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from enum import Enum
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field, field_validator, model_validator
+
+from .config_utils import AUTO, ConfigError, DSConfigModel, is_auto
+
+
+# ---------------------------------------------------------------------------
+# Precision
+# ---------------------------------------------------------------------------
+
+
+class FP16Config(DSConfigModel):
+    """Reference: ``runtime/config.py`` fp16 dict + ``runtime/fp16/loss_scaler.py``."""
+
+    enabled: Union[bool, str] = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+    auto_cast: bool = False
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+class BF16Config(DSConfigModel):
+    enabled: Union[bool, str] = True
+    # Accumulate gradients in fp32 across micro-batches (reference:
+    # bf16 "immediate_grad_update" / grad-accum dtype decisions).
+    accumulate_grads_in_fp32: bool = True
+
+
+class FloatingPointConfig(DSConfigModel):
+    """fp32 master-weight policy."""
+
+    master_weights: bool = True
+    master_dtype: str = "float32"
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / scheduler
+# ---------------------------------------------------------------------------
+
+
+class OptimizerConfig(DSConfigModel):
+    type: str = "adamw"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(DSConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO
+# ---------------------------------------------------------------------------
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"  # TPU-VM host DRAM (pinned_host memory space)
+    nvme = "nvme"
+
+
+class OffloadParamConfig(DSConfigModel):
+    """Reference: ``runtime/zero/offload_config.py`` DeepSpeedZeroOffloadParamConfig."""
+
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = True
+
+
+class OffloadOptimizerConfig(DSConfigModel):
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = True
+    pipeline_read: bool = True
+    pipeline_write: bool = True
+    fast_init: bool = False
+    ratio: float = 1.0  # fraction of optimizer state kept on host
+
+
+class ZeroConfig(DSConfigModel):
+    """Reference: ``runtime/zero/config.py`` DeepSpeedZeroConfig.
+
+    TPU mapping: stages are GSPMD sharding policies over the ``fsdp``/``dp``
+    mesh axes rather than eager partition/gather hooks —
+      stage 0: params+grads+opt replicated over dp (plain allreduce DP)
+      stage 1: optimizer state sharded over dp
+      stage 2: + gradients reduce-scattered (sharded) over dp
+      stage 3: + parameters sharded over dp; XLA inserts per-use all-gathers
+    """
+
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: Union[int, str] = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: Union[int, str] = 500_000_000
+    overlap_comm: Optional[bool] = None
+    offload_param: Optional[OffloadParamConfig] = None
+    offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: Union[int, str] = 50_000_000
+    stage3_param_persistence_threshold: Union[int, str] = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    zero_hpz_partition_size: int = 1  # ZeRO++ hierarchical partition size
+    zero_quantized_weights: bool = False  # ZeRO++ qwZ
+    zero_quantized_gradients: bool = False  # ZeRO++ qgZ
+    mics_shard_size: int = -1  # MiCS: shard within groups of this size
+    mics_hierarchical_params_gather: bool = False
+    round_robin_gradients: bool = False
+    ignore_unused_parameters: bool = True
+    elastic_checkpoint: bool = False
+
+    @field_validator("stage")
+    @classmethod
+    def _valid_stage(cls, v: int) -> int:
+        if v not in (0, 1, 2, 3):
+            raise ValueError(f"zero_optimization.stage must be 0..3, got {v}")
+        return v
+
+
+# ---------------------------------------------------------------------------
+# Parallelism / mesh
+# ---------------------------------------------------------------------------
+
+
+class MeshConfig(DSConfigModel):
+    """TPU-native extension: explicit device-mesh axis sizes.
+
+    Axis order (outer→inner, DCN→ICI friendly): pp, dp, fsdp, ep, sp, tp.
+    ``"auto"`` (==-1) on dp or fsdp absorbs the remaining devices.
+    """
+
+    pipeline_parallel_size: int = 1
+    data_parallel_size: Union[int, str] = AUTO
+    fsdp_size: Union[int, str] = 1
+    expert_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+    # Axes that ride DCN (slower inter-slice links) vs ICI.
+    dcn_axes: List[str] = Field(default_factory=lambda: ["pp", "dp"])
+
+
+class PipelineConfig(DSConfigModel):
+    """Reference: ``runtime/pipe`` config knobs (engine.py pipeline dict)."""
+
+    stages: Union[int, str] = AUTO
+    partition_method: str = "uniform"  # uniform | parameters | type:<regex>
+    num_microbatches: Union[int, str] = AUTO
+    schedule: str = "1f1b"  # 1f1b | gpipe | interleaved
+    activation_checkpoint_interval: int = 0
+
+
+class MoEConfig(DSConfigModel):
+    """Reference: ``deepspeed/moe`` (layer.py MoE / sharded_moe.py TopKGate)."""
+
+    enabled: bool = False
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None  # 'Jitter' | 'RSample' | None
+    drop_tokens: bool = True
+    use_residual: bool = False
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 0.001
+    expert_parallel_size: int = 1
+
+
+class SequenceParallelConfig(DSConfigModel):
+    """Ulysses / ring attention (reference: ``deepspeed/sequence``,
+    ``runtime/sequence_parallel``)."""
+
+    enabled: bool = False
+    size: int = 1
+    mode: str = "ulysses"  # ulysses | ring
+    tiled_mlp: bool = False
+    tiled_logits_loss: bool = False
+    tile_size: int = 2048
+
+
+class TensorParallelConfig(DSConfigModel):
+    """Reference: AutoTP (``module_inject/auto_tp.py``, ``runtime/tensor_parallel``)."""
+
+    enabled: bool = False
+    tp_size: int = 1
+    # module-name patterns to shard column-wise/row-wise; "auto" infers from
+    # model structure the way AutoTP walks nn.Module graphs.
+    partition_spec: Union[str, Dict[str, str]] = AUTO
+
+
+# ---------------------------------------------------------------------------
+# Activation checkpointing / remat
+# ---------------------------------------------------------------------------
+
+
+class ActivationCheckpointingConfig(DSConfigModel):
+    """Reference: ``runtime/activation_checkpointing/config.py``.
+
+    On TPU this maps to ``jax.checkpoint`` policies applied to scanned layers;
+    ``partition_activations`` maps to sharding the remat residuals over tp/sp.
+    """
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False  # offload remat residuals to host memory
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU extension: named remat policy (see runtime/activation_checkpointing)
+    policy: str = "nothing_saveable"  # everything | nothing | dots | dots_with_no_batch_dims
+
+
+# ---------------------------------------------------------------------------
+# Aux subsystems
+# ---------------------------------------------------------------------------
+
+
+class MonitorSinkConfig(DSConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+    # wandb extras
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: Optional[str] = None
+
+
+class FlopsProfilerConfig(DSConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class CommsLoggerConfig(DSConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class AIOConfig(DSConfigModel):
+    """Reference: ``runtime/swap_tensor/aio_config.py``."""
+
+    block_size: int = 1_048_576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+    use_gds: bool = False
+
+
+class DataEfficiencyConfig(DSConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    curriculum_learning: Dict[str, Any] = Field(default_factory=dict)
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+
+
+class CompressionConfig(DSConfigModel):
+    enabled: bool = False
+    weight_quantization: Dict[str, Any] = Field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = Field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = Field(default_factory=dict)
+    row_pruning: Dict[str, Any] = Field(default_factory=dict)
+    head_pruning: Dict[str, Any] = Field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ElasticityConfig(DSConfigModel):
+    """Reference: ``elasticity/config.py`` / ``elasticity.py`` batch math."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_device_count: int = 1
+    max_device_count: int = 10000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.2
+
+
+class AutotuningConfig(DSConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    metric: str = "throughput"  # throughput | latency | flops
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    max_train_batch_size: Optional[int] = None
+    mp_size: int = 1
+    num_tuning_micro_batch_sizes: int = 3
+    tuner_type: str = "gridsearch"  # gridsearch | random | model_based
+    tuner_early_stopping: int = 5
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = False
+
+
+class CheckpointConfig(DSConfigModel):
+    """Reference: engine checkpoint knobs + ``runtime/checkpoint_engine``."""
+
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+    async_save: bool = False
+    engine: str = "native"  # native | orbax | fast
+    keep_n_latest: Optional[int] = None
+
+
+class GradientCompressionConfig(DSConfigModel):
+    """1-bit / compressed-collective options (reference: ``runtime/fp16/onebit``)."""
+
+    enabled: bool = False
+    algorithm: str = "onebit_adam"  # onebit_adam | onebit_lamb | zero_one_adam
+    freeze_step: int = 100_000
+    comm_dtype: str = "int8"
+    cuda_aware: bool = False  # parity knob; ignored on TPU
+
+
+class RematConfig(DSConfigModel):
+    """TPU-native: jax.checkpoint policy for the scanned transformer stack."""
+
+    policy: str = "nothing_saveable"
+    prevent_cse: bool = True
+
+
+class ZenFlowConfig(DSConfigModel):
+    """Reference: ``runtime/zenflow/zenflow_config.py`` — stall-free offload."""
+
+    enabled: bool = False
+    topk_ratio: float = 0.1
+    select_strategy: str = "auto"  # auto | step | epoch
+    select_interval: Union[int, str] = AUTO
+    update_interval: Union[int, str] = AUTO
+    overlap_step: bool = True
+
+
+# ---------------------------------------------------------------------------
+# Root config
+# ---------------------------------------------------------------------------
+
+
+class DeepSpeedTPUConfig(DSConfigModel):
+    """Root config. Reference: ``runtime/config.py:676 DeepSpeedConfig``."""
+
+    # batch size spine
+    train_batch_size: Union[int, str] = AUTO
+    train_micro_batch_size_per_gpu: Union[int, str] = AUTO  # per-device (name kept for parity)
+    gradient_accumulation_steps: Union[int, str] = AUTO
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    dump_state: bool = False
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    gradient_clipping: float = 0.0
+    sparse_gradients: bool = False
+    memory_breakdown: bool = False
+    seed: int = 42
+
+    # precision
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    data_types: FloatingPointConfig = Field(default_factory=FloatingPointConfig)
+
+    optimizer: OptimizerConfig = Field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
+
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+    pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    moe: MoEConfig = Field(default_factory=MoEConfig)
+    sequence_parallel: SequenceParallelConfig = Field(default_factory=SequenceParallelConfig)
+    tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
+
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+    remat: RematConfig = Field(default_factory=RematConfig)
+
+    aio: AIOConfig = Field(default_factory=AIOConfig)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+
+    tensorboard: MonitorSinkConfig = Field(default_factory=MonitorSinkConfig)
+    wandb: MonitorSinkConfig = Field(default_factory=MonitorSinkConfig)
+    csv_monitor: MonitorSinkConfig = Field(default_factory=MonitorSinkConfig)
+
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+
+    data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
+    compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
+    gradient_compression: GradientCompressionConfig = Field(
+        default_factory=GradientCompressionConfig)
+    zenflow: ZenFlowConfig = Field(default_factory=ZenFlowConfig)
+
+    # ------------------------------------------------------------------
+    # derived
+    # ------------------------------------------------------------------
+
+    @model_validator(mode="after")
+    def _check_precision(self) -> "DeepSpeedTPUConfig":
+        if self.fp16.enabled is True and self.bf16.enabled is True:
+            # bf16 defaults on; explicit fp16 wins for parity with torch scripts
+            self.bf16.enabled = False
+        return self
+
+    @property
+    def compute_dtype(self) -> str:
+        if self.fp16.enabled is True:
+            return "float16"
+        if self.bf16.enabled is True:
+            return "bfloat16"
+        return "float32"
+
+    def resolve_batch_config(self, dp_world_size: int) -> "ResolvedBatchConfig":
+        """Reference batch arithmetic (``runtime/config.py`` _configure_train_batch_size):
+        fill in any one unknown of (train_batch, micro_batch, gas)."""
+        tb = None if is_auto(self.train_batch_size) else int(self.train_batch_size)
+        mb = None if is_auto(self.train_micro_batch_size_per_gpu) else int(
+            self.train_micro_batch_size_per_gpu)
+        gas = None if is_auto(self.gradient_accumulation_steps) else int(
+            self.gradient_accumulation_steps)
+
+        if tb is not None and mb is not None and gas is not None:
+            pass  # full specification; consistency-checked below
+        elif tb is not None and mb is not None and gas is None:
+            if tb % (mb * dp_world_size) != 0:
+                raise ConfigError(
+                    f"train_batch_size {tb} not divisible by micro_batch*dp "
+                    f"({mb}*{dp_world_size})")
+            gas = tb // (mb * dp_world_size)
+        elif tb is not None and gas is not None and mb is None:
+            if tb % (gas * dp_world_size) != 0:
+                raise ConfigError(
+                    f"train_batch_size {tb} not divisible by gas*dp ({gas}*{dp_world_size})")
+            mb = tb // (gas * dp_world_size)
+        elif mb is not None:
+            gas = gas or 1
+            tb = mb * gas * dp_world_size
+        elif tb is not None:
+            gas = gas or 1
+            if tb % (gas * dp_world_size) != 0:
+                raise ConfigError(
+                    f"train_batch_size {tb} not divisible by gas*dp ({gas}*{dp_world_size})")
+            mb = tb // (gas * dp_world_size)
+        else:
+            raise ConfigError(
+                "need at least one of train_batch_size / train_micro_batch_size_per_gpu")
+
+        if tb != mb * gas * dp_world_size:
+            raise ConfigError(
+                f"batch config inconsistent: {tb} != {mb} * {gas} * {dp_world_size}")
+        return ResolvedBatchConfig(train_batch_size=tb,
+                                   micro_batch_size_per_device=mb,
+                                   gradient_accumulation_steps=gas,
+                                   dp_world_size=dp_world_size)
+
+
+class ResolvedBatchConfig(DSConfigModel):
+    train_batch_size: int
+    micro_batch_size_per_device: int
+    gradient_accumulation_steps: int
+    dp_world_size: int
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+
+def load_config(config: Union[str, Dict[str, Any], DeepSpeedTPUConfig, None]) -> DeepSpeedTPUConfig:
+    """Accepts a path to a JSON file, a dict, an existing config, or None."""
+    if config is None:
+        return DeepSpeedTPUConfig()
+    if isinstance(config, DeepSpeedTPUConfig):
+        return config
+    if isinstance(config, (str, os.PathLike)):
+        path = os.fspath(config)
+        if not os.path.exists(path):
+            raise ConfigError(f"config file not found: {path}")
+        with open(path) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise ConfigError(f"unsupported config type: {type(config)}")
+    try:
+        return DeepSpeedTPUConfig(**config)
+    except Exception as e:  # re-wrap pydantic errors for a stable API
+        raise ConfigError(str(e)) from e

@@ -1,0 +1,52 @@
+"""Typed-config base machinery.
+
+Capability analogue of the reference's ``deepspeed/runtime/config_utils.py``
+(``DeepSpeedConfigModel``): every feature config is a pydantic model with
+deprecated-field migration, ``"auto"`` value support, and strict unknown-key
+detection so user typos fail loudly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+
+class ConfigError(Exception):
+    """Raised for malformed configs (reference: ``DeepSpeedConfigError``)."""
+
+
+AUTO = "auto"
+
+
+def is_auto(value: Any) -> bool:
+    return isinstance(value, str) and value.lower() == AUTO
+
+
+def resolve_auto(value: Any, default: Any) -> Any:
+    return default if is_auto(value) else value
+
+
+class DSConfigModel(BaseModel):
+    """Base for all feature configs.
+
+    - unknown keys are rejected (``extra="forbid"``)
+    - population by field name or alias
+    - ``"auto"`` sentinel values are allowed where declared.
+    """
+
+    model_config = ConfigDict(
+        extra="forbid",
+        populate_by_name=True,
+        validate_assignment=True,
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def dict_repr(self) -> Dict[str, Any]:
+        return self.model_dump()
+
+
+def get_scalar_param(d: Dict[str, Any], name: str, default: Any) -> Any:
+    return d.get(name, default)

@@ -1,0 +1,15 @@
+from .sharding import (
+    ShardingRules,
+    default_rules,
+    rules_for_params,
+    rules_for_optimizer,
+    logical_to_sharding,
+    shard_pytree,
+    sharding_for_tree,
+    Init,
+)
+
+__all__ = [
+    "ShardingRules", "default_rules", "rules_for_params", "rules_for_optimizer",
+    "logical_to_sharding", "shard_pytree", "sharding_for_tree", "Init",
+]

@@ -1,0 +1,205 @@
+"""ZeRO as GSPMD sharding rules.
+
+The reference implements ZeRO with eager partition/gather machinery
+(``runtime/zero/stage_1_and_2.py``, ``stage3.py``,
+``partition_parameters.py``).  On TPU the same redundancy-elimination is a
+*sharding policy*: express where each tensor class (params / grads /
+optimizer state) lives on the mesh, and XLA's SPMD partitioner inserts the
+exact all-gather / reduce-scatter schedule that DeepSpeed hand-writes —
+including overlap, which XLA's latency-hiding scheduler performs
+automatically.
+
+Stage mapping (over the combined data-parallel world = ``dp`` × ``fsdp``):
+
+========  =================  ==================  ==================
+stage     params             gradients           optimizer state
+========  =================  ==================  ==================
+0         replicated         all-reduced (dp)    replicated
+1         replicated         all-reduced (dp)    sharded over dp
+2         replicated         reduce-scattered    sharded over dp
+3         sharded (fsdp)     reduce-scattered    sharded over fsdp
+========  =================  ==================  ==================
+
+Stage 2's reduce-scatter and stage 1's shard placement need no manual code:
+gradients inherit the optimizer-state sharding through XLA's propagation when
+the update is jitted end-to-end, which turns the grad all-reduce into
+reduce-scatter + sharded update + all-gather of updated params — exactly the
+ZeRO-1/2 schedule (`stage_1_and_2.py:1125 reduce_independent_p_g_buckets...`).
+
+Models annotate each parameter with *logical axis names* (e.g. ``("embed",
+"mlp")``); `ShardingRules` maps logical axes to mesh axes.  This is the
+TPU-idiomatic replacement for ZeRO-3's per-module hooks and also carries
+tensor parallelism (logical ``heads``/``mlp``/``vocab`` → mesh ``tp``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...parallel.topology import MeshTopology
+from ...utils.logging import warning_once
+
+LogicalAxes = Optional[Tuple[Optional[str], ...]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """logical axis name → mesh axis name(s) (None = replicate)."""
+
+    rules: Dict[str, Optional[Tuple[str, ...]]]
+
+    def mesh_axes_for(self, logical: Optional[str]) -> Optional[Tuple[str, ...]]:
+        if logical is None:
+            return None
+        return self.rules.get(logical)
+
+    def updated(self, **kv) -> "ShardingRules":
+        new = dict(self.rules)
+        for k, v in kv.items():
+            new[k] = tuple(v) if v is not None else None
+        return ShardingRules(new)
+
+
+def default_rules(stage: int, topo: MeshTopology, shard_axis: str = "embed") -> ShardingRules:
+    """Base logical→mesh mapping for a given ZeRO stage.
+
+    ``shard_axis`` is the logical axis fully-sharded parameters split on
+    (reference stage-3 flattens and splits; we split the embed axis, which
+    every transformer weight has and which keeps all-gathers contiguous).
+    """
+    rules: Dict[str, Optional[Tuple[str, ...]]] = {
+        # activations
+        "batch": ("dp", "fsdp"),
+        "seq": ("sp",),
+        # tensor parallel weight axes
+        "heads": ("tp",),
+        "kv_heads": ("tp",),
+        "mlp": ("tp",),
+        "vocab": ("tp",),
+        "qkv": None,
+        "embed": None,
+        "kv": None,
+        # stacks / experts
+        "layers": None,
+        "expert": ("ep",),
+    }
+    if stage >= 3:
+        rules[shard_axis] = ("fsdp",)
+    return ShardingRules(rules)
+
+
+def rules_for_params(stage: int, topo: MeshTopology) -> ShardingRules:
+    return default_rules(stage, topo)
+
+
+def rules_for_optimizer(stage: int, topo: MeshTopology) -> ShardingRules:
+    """Optimizer-state sharding: stages 1/2 shard over the *whole* DP world
+    (dp and fsdp axes) even though params stay replicated — ZeRO-1's core idea."""
+    rules = default_rules(stage, topo)
+    if stage in (1, 2):
+        rules = rules.updated(embed=("dp", "fsdp"))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# applying rules to pytrees
+# ---------------------------------------------------------------------------
+
+
+def _spec_for(shape: Tuple[int, ...], axes: LogicalAxes, rules: ShardingRules,
+              topo: MeshTopology) -> P:
+    if axes is None:
+        return P()
+    if len(axes) != len(shape):
+        warning_once(f"logical axes {axes} rank-mismatch shape {shape}; replicating")
+        return P()
+    spec = []
+    used: set = set()
+    for dim, logical in zip(shape, axes):
+        mesh_axes = rules.mesh_axes_for(logical)
+        if not mesh_axes:
+            spec.append(None)
+            continue
+        mesh_axes = tuple(a for a in mesh_axes if a not in used and topo.size(a) > 1)
+        total = int(np.prod([topo.size(a) for a in mesh_axes])) if mesh_axes else 1
+        if total <= 1 or dim % total != 0:
+            if total > 1:
+                warning_once(
+                    f"dim {dim} (logical {logical!r}) not divisible by mesh "
+                    f"axes {mesh_axes} (={total}); replicating that dim")
+            spec.append(None)
+            continue
+        used.update(mesh_axes)
+        spec.append(mesh_axes if len(mesh_axes) > 1 else mesh_axes[0])
+    return P(*spec)
+
+
+def logical_to_sharding(shape: Tuple[int, ...], axes: LogicalAxes, rules: ShardingRules,
+                        topo: MeshTopology) -> NamedSharding:
+    return NamedSharding(topo.mesh, _spec_for(tuple(shape), axes, rules, topo))
+
+
+def _is_axes_leaf(x: Any) -> bool:
+    return x is None or (isinstance(x, tuple)
+                         and all(isinstance(e, (str, type(None))) for e in x))
+
+
+def sharding_for_tree(tree_shapes: Any, tree_axes: Any, rules: ShardingRules,
+                      topo: MeshTopology) -> Any:
+    """Build a NamedSharding pytree for ``tree_shapes`` (of ShapeDtypeStruct or
+    arrays) guided by a pytree of logical-axes tuples.
+
+    ``tree_axes`` may be a *prefix* tree of ``tree_shapes`` — an axes tuple or
+    ``None`` at any node applies to the whole matching subtree (``None`` ⇒
+    replicate it).
+    """
+
+    def one(leaf, axes):
+        shape = tuple(leaf.shape) if hasattr(leaf, "shape") else ()
+        return logical_to_sharding(shape, axes, rules, topo)
+
+    # Map over the prefix tree first so each axes node sees its whole subtree.
+    return jax.tree.map(
+        lambda axes, subtree: jax.tree.map(lambda leaf: one(leaf, axes), subtree),
+        tree_axes, tree_shapes, is_leaf=_is_axes_leaf)
+
+
+def shard_pytree(tree: Any, tree_axes: Any, rules: ShardingRules,
+                 topo: MeshTopology) -> Any:
+    """device_put every leaf with its computed sharding (eager placement)."""
+    shardings = sharding_for_tree(tree, tree_axes, rules, topo)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)
+
+
+# ---------------------------------------------------------------------------
+# zero.Init — shard-at-construction context
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def Init(topo: MeshTopology, rules: Optional[ShardingRules] = None, stage: int = 3):
+    """Shard-at-construction context (reference: ``partition_parameters.py:884
+    zero.Init``).
+
+    The reference intercepts ``nn.Module.__init__`` to partition each tensor
+    as it is created so no rank ever materializes the full model.  The JAX
+    equivalent: run the model's ``init`` under ``jax.jit`` with sharded
+    *output* shardings so each process only materializes its shards.  This
+    context manager exposes ``init_sharded(init_fn, axes_tree, *args)`` doing
+    exactly that.
+    """
+    rules = rules or rules_for_params(stage, topo)
+
+    class _Ctx:
+        def init_sharded(self, init_fn, axes_tree, *args, **kwargs):
+            shapes = jax.eval_shape(init_fn, *args, **kwargs)
+            shardings = sharding_for_tree(shapes, axes_tree, rules, topo)
+            return jax.jit(init_fn, out_shardings=shardings)(*args, **kwargs)
+
+    yield _Ctx()

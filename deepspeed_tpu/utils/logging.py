@@ -1,0 +1,69 @@
+"""Rank-aware logging utilities.
+
+TPU-native analogue of the reference's ``deepspeed/utils/logging.py``
+(``logger`` / ``log_dist``): rank filtering is derived from
+``jax.process_index()`` instead of torch.distributed ranks.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(logging.Formatter(LOG_FORMAT))
+    lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    # Avoid importing jax at module import time (keeps env-var setup ordering sane
+    # for tests that force the CPU platform).
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("RANK", 0))
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (default: rank 0).
+
+    ``ranks=[-1]`` logs on every process.
+    """
+    ranks = list(ranks) if ranks is not None else [0]
+    me = _process_index()
+    if -1 in ranks or me in ranks:
+        logger.log(level, f"[Rank {me}] {message}")
+
+
+def should_log_le(max_log_level: str) -> bool:
+    mapping = logging.getLevelNamesMapping()
+    wanted = mapping.get(max_log_level.upper())
+    if wanted is None:
+        raise ValueError(f"invalid log level: {max_log_level!r}")
+    return logger.getEffectiveLevel() <= wanted
+
+
+def warning_once(message: str) -> None:
+    _warning_once_impl(message)
+
+
+@functools.lru_cache(None)
+def _warning_once_impl(message: str) -> None:
+    logger.warning(message)

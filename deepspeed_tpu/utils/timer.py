@@ -1,0 +1,192 @@
+"""Wall-clock timers and throughput accounting.
+
+Capability analogue of the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer``, ``ThroughputTimer``). On TPU,
+"synchronized" means draining the async dispatch queue
+(``jax.block_until_ready`` / ``jax.effects_barrier``) instead of
+``cudaDeviceSynchronize``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+try:
+    import psutil
+
+    _PSUTIL = True
+except Exception:  # pragma: no cover
+    _PSUTIL = False
+
+
+def _device_sync() -> None:
+    """Drain all in-flight device work (the cudaDeviceSynchronize analogue).
+
+    ``jax.effects_barrier`` only waits for *effectful* computations, so a pure
+    jitted program would not be awaited; PJRT's per-device
+    ``synchronize_all_activity`` drains everything.
+    """
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            d.synchronize_all_activity()
+    except Exception:
+        try:
+            import jax
+
+            jax.effects_barrier()
+        except Exception:
+            pass
+
+
+class _Timer:
+    def __init__(self, name: str, synchronize: bool = True):
+        self.name = name
+        self.synchronize = synchronize
+        self._started: Optional[float] = None
+        self._elapsed = 0.0
+        self.count = 0
+
+    def start(self) -> None:
+        if self._started is not None:
+            raise RuntimeError(f"timer {self.name} already started")
+        if self.synchronize:
+            _device_sync()
+        self._started = time.perf_counter()
+
+    def stop(self, record_count: int = 1) -> None:
+        if self._started is None:
+            raise RuntimeError(f"timer {self.name} not started")
+        if self.synchronize:
+            _device_sync()
+        self._elapsed += time.perf_counter() - self._started
+        self._started = None
+        self.count += record_count
+
+    def reset(self) -> None:
+        self._started = None
+        self._elapsed = 0.0
+        self.count = 0
+
+    def elapsed(self, reset: bool = True) -> float:
+        value = self._elapsed
+        if self._started is not None:
+            value += time.perf_counter() - self._started
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self) -> float:
+        if self.count == 0:
+            return 0.0
+        return self._elapsed / self.count
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry; ``log`` prints elapsed ms for a set of timers."""
+
+    def __init__(self, synchronize: bool = True):
+        self.timers: Dict[str, _Timer] = {}
+        self.synchronize = synchronize
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name, synchronize=self.synchronize)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        parts: List[str] = []
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0)
+            peak = stats.get("peak_bytes_in_use", 0)
+            parts.append(f"device mem: {in_use / 2**30:.2f} GB (peak {peak / 2**30:.2f} GB)")
+        except Exception:
+            pass
+        if _PSUTIL:
+            vm = psutil.virtual_memory()
+            parts.append(f"host mem: {vm.used / 2**30:.2f}/{vm.total / 2**30:.2f} GB")
+        return " | ".join(parts)
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None) -> None:
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        assert normalizer > 0.0
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names
+            if name in self.timers
+        }
+
+
+class ThroughputTimer:
+    """Tracks samples/sec and (given a FLOPs estimate) TFLOPS per device."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: Optional[int] = None, monitor_memory: bool = False):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.epoch_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._started: Optional[float] = None
+        self.started_ = False
+
+    def update_epoch_count(self) -> None:
+        self.epoch_count += 1
+
+    def start(self) -> None:
+        self.started_ = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self._started = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
+        if not self.started_:
+            return
+        self.started_ = False
+        if global_step:
+            self.global_step_count += 1
+        if self._started is not None:
+            _device_sync()
+            duration = time.perf_counter() - self._started
+            self._started = None
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and self.steps_per_output and \
+                    self.global_step_count % self.steps_per_output == 0:
+                log_dist(
+                    f"epoch={self.epoch_count}/step={self.global_step_count}, "
+                    f"throughput={self.avg_samples_per_sec():.2f} samples/s, "
+                    f"latency={self.step_elapsed_time / self.steps_per_output:.3f} s",
+                )
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        timed_steps = max(1, self.global_step_count - self.start_step)
+        if self.total_elapsed_time == 0.0:
+            return 0.0
+        return self.batch_size / (self.total_elapsed_time / timed_steps)

@@ -1,0 +1,41 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+The reference's distributed unit tests multiplex one host into N ranks via a
+process pool (``tests/unit/common.py DistributedTest``).  The JAX-native
+equivalent needs no processes at all: ``--xla_force_host_platform_device_count``
+gives N virtual CPU devices in-process, and every multi-chip code path
+(shard_map, collectives, GSPMD) runs against them unchanged.
+
+Note: platform selection must go through ``jax.config`` (not JAX_PLATFORMS):
+this image's sitecustomize registers the TPU PJRT plugin at interpreter start,
+which wins over the env var.
+"""
+
+import os
+
+# Must be in place before the XLA CPU client initializes.
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    yield
+    from deepspeed_tpu.parallel import topology
+
+    topology.reset_topology()

@@ -1,0 +1,88 @@
+"""Config-system tests (reference model: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import (
+    DeepSpeedTPUConfig,
+    load_config,
+)
+from deepspeed_tpu.runtime.config_utils import ConfigError
+
+
+def test_default_config():
+    cfg = load_config(None)
+    assert cfg.zero_optimization.stage == 0
+    assert cfg.compute_dtype == "bfloat16"
+
+
+def test_deepspeed_style_json(tmp_path):
+    ds = {
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": "auto",
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 100}},
+        "zero_optimization": {"stage": 2, "reduce_bucket_size": 5e8,
+                              "offload_optimizer": {"device": "cpu"}},
+        "bf16": {"enabled": True},
+        "wall_clock_breakdown": True,
+    }
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps(ds))
+    cfg = load_config(str(p))
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.zero_optimization.offload_optimizer.device.value == "cpu"
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.gradient_clipping == 1.0
+
+
+def test_batch_math_fill_gas():
+    cfg = load_config({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2})
+    rb = cfg.resolve_batch_config(dp_world_size=4)
+    assert rb.gradient_accumulation_steps == 4
+    assert rb.train_batch_size == 32
+
+
+def test_batch_math_fill_micro():
+    cfg = load_config({"train_batch_size": 64, "gradient_accumulation_steps": 2})
+    rb = cfg.resolve_batch_config(dp_world_size=8)
+    assert rb.micro_batch_size_per_device == 4
+
+
+def test_batch_math_fill_train():
+    cfg = load_config({"train_micro_batch_size_per_gpu": 3})
+    rb = cfg.resolve_batch_config(dp_world_size=2)
+    assert rb.train_batch_size == 6
+    assert rb.gradient_accumulation_steps == 1
+
+
+def test_batch_math_inconsistent():
+    cfg = load_config({"train_batch_size": 30, "train_micro_batch_size_per_gpu": 4})
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch_config(dp_world_size=4)
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigError):
+        load_config({"train_batch_sizee": 32})
+
+
+def test_invalid_zero_stage():
+    with pytest.raises(ConfigError):
+        load_config({"zero_optimization": {"stage": 5}})
+
+
+def test_fp16_beats_default_bf16():
+    cfg = load_config({"fp16": {"enabled": True}})
+    assert cfg.compute_dtype == "float16"
+    assert cfg.fp16.dynamic_loss_scale
+
+
+def test_batch_math_fully_specified_inconsistent():
+    cfg = load_config({"train_batch_size": 100, "train_micro_batch_size_per_gpu": 2,
+                       "gradient_accumulation_steps": 1})
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch_config(dp_world_size=8)

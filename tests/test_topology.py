@@ -1,0 +1,48 @@
+"""Mesh-topology tests (reference model: tests/unit for utils/groups.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import MeshTopology
+from deepspeed_tpu.runtime.config import MeshConfig
+from deepspeed_tpu.runtime.config_utils import ConfigError
+
+
+def test_auto_dp(devices):
+    topo = MeshTopology.from_config(MeshConfig())
+    assert topo.size("dp") == 8
+    assert topo.world_size == 8
+    assert topo.dp_world_size == 8
+
+
+def test_tp_mesh(devices):
+    topo = MeshTopology.from_config(MeshConfig(tensor_parallel_size=2))
+    assert topo.size("tp") == 2
+    assert topo.size("dp") == 4
+    assert topo.mesh.shape["tp"] == 2
+
+
+def test_fsdp_absorbs(devices):
+    topo = MeshTopology.from_config(
+        MeshConfig(fsdp_size="auto", data_parallel_size=2, tensor_parallel_size=2))
+    assert topo.size("fsdp") == 2
+    assert topo.dp_world_size == 4
+
+
+def test_indivisible_raises(devices):
+    with pytest.raises(ConfigError):
+        MeshTopology.from_config(MeshConfig(tensor_parallel_size=3))
+
+
+def test_full_composition(devices):
+    topo = MeshTopology.from_config(
+        MeshConfig(pipeline_parallel_size=2, tensor_parallel_size=2,
+                   sequence_parallel_size=2, data_parallel_size=1))
+    assert topo.world_size == 8
+    assert topo.active_axes() == ["pp", "sp", "tp"]
+
+
+def test_coord_mapping(devices):
+    topo = MeshTopology.from_config(MeshConfig(tensor_parallel_size=2))
+    c0 = topo.coord_of(0)
+    c1 = topo.coord_of(1)
+    assert c0["tp"] == 0 and c1["tp"] == 1  # tp is innermost

@@ -1,0 +1,89 @@
+"""ZeRO sharding-rule tests (reference model: tests/unit/runtime/zero/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.topology import MeshTopology
+from deepspeed_tpu.runtime.config import MeshConfig
+from deepspeed_tpu.runtime.zero import sharding as zs
+
+
+@pytest.fixture
+def topo_fsdp8(devices):
+    return MeshTopology.from_config(MeshConfig(fsdp_size=8, data_parallel_size=1))
+
+
+@pytest.fixture
+def topo_dp8(devices):
+    return MeshTopology.from_config(MeshConfig())
+
+
+def test_stage0_replicated(topo_dp8):
+    rules = zs.rules_for_params(0, topo_dp8)
+    s = zs.logical_to_sharding((16, 32), ("embed", "mlp"), rules, topo_dp8)
+    assert s.is_fully_replicated
+
+
+def test_stage3_params_sharded(topo_fsdp8):
+    rules = zs.rules_for_params(3, topo_fsdp8)
+    s = zs.logical_to_sharding((16, 32), ("embed", "mlp"), rules, topo_fsdp8)
+    assert not s.is_fully_replicated
+    assert s.spec[0] == ("fsdp",) or s.spec[0] == "fsdp"
+
+
+def test_stage1_optimizer_sharded_params_replicated(topo_dp8):
+    prules = zs.rules_for_params(1, topo_dp8)
+    orules = zs.rules_for_optimizer(1, topo_dp8)
+    ps = zs.logical_to_sharding((16, 32), ("embed", "mlp"), prules, topo_dp8)
+    os_ = zs.logical_to_sharding((16, 32), ("embed", "mlp"), orules, topo_dp8)
+    assert ps.is_fully_replicated
+    assert not os_.is_fully_replicated
+
+
+def test_indivisible_dim_replicates(topo_fsdp8):
+    rules = zs.rules_for_params(3, topo_fsdp8)
+    s = zs.logical_to_sharding((15, 32), ("embed", "mlp"), rules, topo_fsdp8)
+    assert s.is_fully_replicated  # 15 % 8 != 0 → fall back, don't crash
+
+
+def test_shard_pytree_places_leaves(topo_fsdp8):
+    tree = {"w": jnp.ones((16, 8)), "b": jnp.ones((8,))}
+    axes = {"w": ("embed", "mlp"), "b": (None,)}
+    rules = zs.rules_for_params(3, topo_fsdp8)
+    out = zs.shard_pytree(tree, axes, rules, topo_fsdp8)
+    assert not out["w"].sharding.is_fully_replicated
+    assert out["b"].sharding.is_fully_replicated
+    np.testing.assert_allclose(np.asarray(out["w"]), np.ones((16, 8)))
+
+
+def test_zero_init_shards_at_construction(topo_fsdp8):
+    def init_fn():
+        k = jax.random.PRNGKey(0)
+        return {"w": jax.random.normal(k, (64, 32))}
+
+    with zs.Init(topo_fsdp8, stage=3) as ctx:
+        params = ctx.init_sharded(init_fn, {"w": ("embed", "mlp")})
+    assert not params["w"].sharding.is_fully_replicated
+    # each device holds 1/8 of rows
+    shard = params["w"].addressable_shards[0]
+    assert shard.data.shape == (8, 32)
+
+
+def test_tp_rules(devices):
+    topo = MeshTopology.from_config(MeshConfig(tensor_parallel_size=2))
+    rules = zs.rules_for_params(0, topo)
+    s = zs.logical_to_sharding((16, 64), ("embed", "mlp"), rules, topo)
+    assert s.spec[1] in ("tp", ("tp",))
+
+
+def test_sharding_for_tree_prefix_broadcast(topo_fsdp8):
+    rules = zs.rules_for_params(3, topo_fsdp8)
+    tree = {"a": {"w": jnp.ones((16, 8)), "v": jnp.ones((8, 8))}}
+    # prefix: one axes tuple covers the whole subtree
+    out = zs.sharding_for_tree(tree, {"a": ("embed", "mlp")}, rules, topo_fsdp8)
+    assert not out["a"]["w"].is_fully_replicated
+    # None prefix replicates everything
+    out2 = zs.sharding_for_tree(tree, None, rules, topo_fsdp8)
+    assert out2["a"]["w"].is_fully_replicated
