@@ -1,0 +1,97 @@
+"""Benchmark: training-step throughput on the flagship model family, one chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The metric is model FLOPs utilisation (MFU) of a bf16 ZeRO training step of a
+LLaMA-architecture model sized for the available chip — the single-chip proxy
+for BASELINE.json's "tokens/sec/chip at 8B ZeRO-3 ≥45% MFU on v5e-256" target.
+``vs_baseline`` = achieved_MFU / 0.45 (the reference north-star MFU).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.models import transformer as tfm
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    accel = get_accelerator()
+    on_tpu = accel.platform() not in ("cpu",)
+
+    if on_tpu:
+        # ~0.6B-param LLaMA-architecture model: big enough to saturate the MXU,
+        # small enough (bf16 params+grads+adam on 16G HBM) for one v5e chip.
+        cfg = tfm.get_config(
+            "llama3-8b", num_layers=12, hidden_size=2048,
+            intermediate_size=5632, num_heads=16, num_kv_heads=8,
+            vocab_size=32000, max_seq_len=2048, param_dtype="bfloat16")
+        micro, seq, steps, warmup = 4, 2048, 10, 3
+    else:  # CI smoke path
+        cfg = tfm.get_config("tiny")
+        micro, seq, steps, warmup = 2, 128, 3, 1
+
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+
+    def loss_fn(p, batch, rng):
+        return tfm.loss_fn(p, batch, cfg)
+
+    spec = ModelSpec(loss_fn=loss_fn, params=params,
+                     param_axes=tfm.param_axes(cfg))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=spec,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 10_000,
+        },
+    )
+
+    batch = {"input_ids": np.random.randint(
+        0, cfg.vocab_size, size=(engine.train_batch_size, seq)).astype(np.int32)}
+
+    for _ in range(warmup):
+        engine.train_batch(batch)
+    accel.synchronize()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_batch(batch)
+    accel.synchronize()
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = engine.train_batch_size * (seq - 1)
+    tokens_per_sec = tokens_per_step / dt
+
+    # 6*N + attention FLOPs per token (PaLM appendix B convention)
+    n_params = cfg.num_params(include_embed=False)
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+    peak = accel.peak_tflops("bfloat16") * len(jax.devices())
+    mfu = achieved_tflops / peak if peak else 0.0
+
+    print(json.dumps({
+        "metric": "train_step_mfu_0p6b_llama_1chip" if on_tpu else "train_step_mfu_smoke_cpu",
+        "value": round(mfu, 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "tokens_per_sec_per_chip": round(tokens_per_sec / len(jax.devices()), 1),
+            "achieved_tflops": round(achieved_tflops, 2),
+            "step_time_s": round(dt, 4),
+            "model_params_m": round(cfg.num_params() / 1e6, 1),
+            "device": accel.device_kind(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

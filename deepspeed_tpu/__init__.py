@@ -1,0 +1,60 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework
+with DeepSpeed's capability surface, built on JAX/XLA/Pallas.
+
+Public API mirrors the reference (``deepspeed/__init__.py``):
+
+    engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config=cfg_dict)
+    engine.train_batch(batch)
+
+See SURVEY.md for the capability map against deepspeedai/DeepSpeed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+__version__ = "0.1.0"
+
+from . import comm  # noqa: E402
+from .accelerator import get_accelerator  # noqa: E402
+from .runtime.config import DeepSpeedTPUConfig, load_config  # noqa: E402
+from .runtime.engine import ModelSpec, TrainingEngine  # noqa: E402
+
+
+def initialize(model: Union[ModelSpec, Any] = None,
+               config: Union[str, Dict, DeepSpeedTPUConfig, None] = None,
+               config_params: Union[str, Dict, None] = None,
+               model_params: Any = None,
+               param_axes: Any = None,
+               loss_fn: Any = None,
+               topo=None,
+               dist_init_required: Optional[bool] = None,
+               **kwargs) -> Tuple[TrainingEngine, Any, Any, Any]:
+    """Create a training engine.  Reference: ``deepspeed.initialize``
+    (``deepspeed/__init__.py:93``) — returns (engine, optimizer, dataloader,
+    lr_scheduler); the last three are carried on the engine in this functional
+    design but returned for drop-in shape compatibility.
+
+    ``model`` may be a :class:`ModelSpec`, or pass ``loss_fn`` +
+    ``model_params`` (+ optional ``param_axes``) separately.
+    """
+    cfg = load_config(config if config is not None else config_params)
+
+    if dist_init_required is None or dist_init_required:
+        comm.init_distributed(verbose=False)
+
+    if not isinstance(model, ModelSpec):
+        if loss_fn is None or model_params is None:
+            raise ValueError(
+                "pass model=ModelSpec(...) or loss_fn= and model_params=")
+        model = ModelSpec(loss_fn=loss_fn, params=model_params, param_axes=param_axes)
+
+    engine = TrainingEngine(model, cfg, topo=topo)
+    return engine, engine.optimizer, None, engine.lr_schedule
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Reference: ``deepspeed.init_inference`` (``__init__.py:328``)."""
+    from .inference.engine import InferenceEngine
+
+    return InferenceEngine(model=model, config=config, **kwargs)

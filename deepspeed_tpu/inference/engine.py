@@ -1,0 +1,189 @@
+"""Inference engine v1.
+
+Capability analogue of the reference's ``deepspeed/inference/engine.py``
+(``InferenceEngine:40``): wrap a model for generation with tensor-parallel
+sharding and fused decode.  TPU-native: a jitted prefill step + a jitted
+single-token decode step over a static KV cache (static shapes keep XLA
+happy); TP sharding comes from the same logical-axis rules as training.
+
+The v2-style ragged/continuous-batching engine (paged KV cache + scheduler)
+lives in ``deepspeed_tpu/inference/v2/``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import transformer as tfm
+from ..parallel.topology import MeshTopology
+from ..runtime.config import MeshConfig, load_config
+from ..runtime.zero.sharding import rules_for_params, sharding_for_tree
+
+
+@dataclasses.dataclass
+class InferenceConfig:
+    tensor_parallel_size: int = 1
+    max_seq_len: int = 2048
+    max_batch_size: int = 8
+    dtype: str = "bfloat16"
+
+
+def _kv_cache_init(cfg: tfm.TransformerConfig, batch: int, max_len: int, dtype):
+    L, kvh, hd = cfg.num_layers, cfg.kv_heads, cfg.head_dim
+    shape = (L, batch, max_len, kvh, hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "length": jnp.zeros((batch,), jnp.int32)}
+
+
+def forward_cached(params, tokens, cache, start_pos, cfg: tfm.TransformerConfig):
+    """Forward over ``tokens`` (B, T) with KV cache starting at ``start_pos``.
+
+    Returns (logits_last, new_cache).  Works for prefill (T = prompt len) and
+    decode (T = 1).  Causal masking accounts for cache offset.
+    """
+    dt = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    max_len = cache["k"].shape[2]
+
+    x = params["embed"]["tokens"].astype(dt)[tokens]
+    if cfg.position == "learned":
+        pos_ids = start_pos + jnp.arange(T)
+        x = x + params["embed"]["position"].astype(dt)[pos_ids][None]
+    cos_full, sin_full = (None, None)
+    if cfg.position == "rope":
+        cos_full, sin_full = tfm.rope_table(max_len, cfg.head_dim, cfg.rope_theta)
+
+    def layer_body(carry, inputs):
+        h, = carry
+        layer_params, layer_k, layer_v = inputs
+        a_in = tfm._norm(h, layer_params["ln1"], cfg.norm, cfg.norm_eps)
+        nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        q = (a_in @ layer_params["attn"]["wq"].astype(dt)).reshape(B, T, nh, hd)
+        k = (a_in @ layer_params["attn"]["wk"].astype(dt)).reshape(B, T, nkv, hd)
+        v = (a_in @ layer_params["attn"]["wv"].astype(dt)).reshape(B, T, nkv, hd)
+        if cfg.position == "rope":
+            cos = jax.lax.dynamic_slice_in_dim(cos_full, start_pos, T)
+            sin = jax.lax.dynamic_slice_in_dim(sin_full, start_pos, T)
+            q = tfm.apply_rope(q, cos, sin)
+            k = tfm.apply_rope(k, cos, sin)
+        # write new kv into the cache at start_pos
+        new_k = jax.lax.dynamic_update_slice(layer_k, k.astype(layer_k.dtype),
+                                             (0, start_pos, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(layer_v, v.astype(layer_v.dtype),
+                                             (0, start_pos, 0, 0))
+        # attend over cache[0:start_pos+T]
+        kk, vv = new_k, new_v  # (B, max_len, KV, D)
+        if nkv != nh:
+            rep = nh // nkv
+            kk = jnp.repeat(kk, rep, axis=2)
+            vv = jnp.repeat(vv, rep, axis=2)
+        import math as _math
+
+        logits = jnp.einsum("bthd,bshd->bhts", q, kk) / _math.sqrt(hd)
+        logits = logits.astype(jnp.float32)
+        key_pos = jnp.arange(max_len)[None, None, None, :]
+        qry_pos = (start_pos + jnp.arange(T))[None, None, :, None]
+        mask = key_pos <= qry_pos
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+        o = jnp.einsum("bhts,bshd->bthd", probs, vv).reshape(B, T, nh * hd)
+        h = h + o @ layer_params["attn"]["wo"].astype(dt)
+
+        m_in = tfm._norm(h, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+        if cfg.num_experts > 0:
+            from ..moe.layer import dense_moe_block
+
+            h = h + dense_moe_block(m_in, layer_params["moe"], cfg)
+        else:
+            h = h + tfm._mlp_block(m_in, layer_params["mlp"], cfg)
+        return (h,), (new_k, new_v)
+
+    (x,), (new_ks, new_vs) = jax.lax.scan(
+        layer_body, (x,), (params["layers"], cache["k"], cache["v"]))
+
+    x = tfm._norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = x[:, -1] @ params["embed"]["tokens"].astype(dt).T
+    else:
+        logits = x[:, -1] @ params["lm_head"]["w"].astype(dt)
+    new_cache = {"k": new_ks, "v": new_vs,
+                 "length": cache["length"] + T}
+    return logits.astype(jnp.float32), new_cache
+
+
+class InferenceEngine:
+    """Reference: ``InferenceEngine`` — ``.generate()`` with TP sharding."""
+
+    def __init__(self, model=None, config=None, model_config=None, params=None,
+                 **kwargs):
+        if isinstance(config, dict):
+            icfg = InferenceConfig(**{k: v for k, v in config.items()
+                                      if k in InferenceConfig.__dataclass_fields__})
+        elif isinstance(config, InferenceConfig):
+            icfg = config
+        else:
+            icfg = InferenceConfig()
+        self.config = icfg
+
+        if model is not None and hasattr(model, "params"):
+            # ModelSpec-style bundle; model_config must be the TransformerConfig
+            params = model.params
+        if model_config is None or params is None:
+            raise ValueError("pass model_config=TransformerConfig and params=")
+        self.model_config = dataclasses.replace(model_config, dtype=icfg.dtype)
+        # dp absorbs the remaining devices (params replicated across it)
+        self.topo = MeshTopology.from_config(
+            MeshConfig(tensor_parallel_size=icfg.tensor_parallel_size))
+        rules = rules_for_params(0, self.topo)
+        shardings = sharding_for_tree(params, tfm.param_axes(self.model_config),
+                                      rules, self.topo)
+        self.params = jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s),
+                                   params, shardings)
+
+        self._prefill = jax.jit(partial(forward_cached, cfg=self.model_config),
+                                static_argnames=())
+        self._decode = jax.jit(partial(forward_cached, cfg=self.model_config))
+
+    def generate(self, input_ids: np.ndarray, max_new_tokens: int = 32,
+                 temperature: float = 0.0, seed: int = 0,
+                 eos_token_id: Optional[int] = None) -> np.ndarray:
+        """Greedy / temperature sampling. input_ids: (B, T_prompt) int32."""
+        tokens = jnp.asarray(input_ids, jnp.int32)
+        B, T = tokens.shape
+        max_len = min(self.config.max_seq_len,
+                      T + max_new_tokens)
+        cache = _kv_cache_init(self.model_config, B, max_len,
+                               jnp.dtype(self.config.dtype))
+        rng = jax.random.PRNGKey(seed)
+
+        logits, cache = self._prefill(self.params, tokens, cache, 0)
+        out = [tokens]
+        cur = self._sample(logits, rng, temperature)
+        out.append(cur[:, None])
+        finished = jnp.zeros((B,), bool)
+        for i in range(max_new_tokens - 1):
+            rng, step_rng = jax.random.split(rng)
+            pos = T + i
+            if pos >= max_len:
+                break
+            logits, cache = self._decode(self.params, cur[:, None], cache, pos)
+            cur = self._sample(logits, step_rng, temperature)
+            if eos_token_id is not None:
+                finished = finished | (cur == eos_token_id)
+                cur = jnp.where(finished, eos_token_id, cur)
+            out.append(cur[:, None])
+            if eos_token_id is not None and bool(finished.all()):
+                break
+        return np.asarray(jnp.concatenate(out, axis=1))
+
+    @staticmethod
+    def _sample(logits: jax.Array, rng: jax.Array, temperature: float) -> jax.Array:
+        if temperature <= 0.0:
+            return logits.argmax(-1).astype(jnp.int32)
+        return jax.random.categorical(rng, logits / temperature).astype(jnp.int32)

@@ -1,0 +1,400 @@
+"""Decoder-only transformer family (GPT-2 / LLaMA / Mixtral-style).
+
+This is the framework's flagship model zoo, built TPU-first:
+
+* parameters are a plain pytree with a parallel *logical-axes* pytree
+  (``embed``/``mlp``/``heads``/``vocab``/``layers``...) consumed by the ZeRO/TP
+  sharding rules (`runtime/zero/sharding.py`);
+* the layer stack is **stacked and scanned** (`lax.scan`), which is what makes
+  ZeRO-3-style gather-per-layer expressible as program structure under XLA
+  (SURVEY.md §7 "hard parts") instead of eager hooks;
+* rematerialisation is a `jax.checkpoint` policy on the scanned body;
+* attention is pluggable (XLA einsum reference path, Pallas flash kernel,
+  Ulysses/ring sequence-parallel wrappers).
+
+Covers the reference's training-side model needs (the reference itself defers
+models to user code / HF; its fused transformer block lives in
+``csrc/transformer`` — here the block is this module + Pallas kernels).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    intermediate_size: int = 1408
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None  # None => MHA; < num_heads => GQA
+    max_seq_len: int = 2048
+    # architecture switches
+    norm: str = "rmsnorm"  # rmsnorm (llama) | layernorm (gpt2)
+    activation: str = "silu"  # silu => SwiGLU (llama); gelu => GELU MLP (gpt2)
+    position: str = "rope"  # rope (llama) | learned (gpt2)
+    tie_embeddings: bool = True
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    # MoE (0 == dense); see deepspeed_tpu/moe for the layer implementation
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    # dtypes
+    dtype: str = "bfloat16"  # compute dtype
+    param_dtype: str = "float32"  # master weights
+    # attention implementation: 'xla' | 'flash' | 'ulysses' | 'ring'
+    attn_impl: str = "xla"
+    # remat policy name for the scanned stack
+    remat_policy: str = "nothing_saveable"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def flops_per_token(self) -> float:
+        """Dense fwd+bwd FLOPs/token ≈ 6N + attention term (PaLM appendix B)."""
+        n_params = self.num_params(include_embed=False)
+        attn = 12 * self.num_layers * self.hidden_size * self.max_seq_len
+        return 6 * n_params + attn
+
+    def num_params(self, include_embed: bool = True) -> int:
+        h, f, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
+        kvh = self.kv_heads * self.head_dim
+        per_layer = h * h + 2 * h * kvh + h * h  # q, k, v, o
+        n_mlp = 3 * h * f if self.activation == "silu" else 2 * h * f
+        if self.num_experts > 0:
+            n_mlp = n_mlp * self.num_experts + h * self.num_experts  # experts + router
+        per_layer += n_mlp + 2 * h
+        total = L * per_layer + h  # + final norm
+        if include_embed:
+            total += v * h if self.tie_embeddings else 2 * v * h
+            if self.position == "learned":
+                total += self.max_seq_len * h
+        return total
+
+
+# ---------------------------------------------------------------------------
+# presets (BASELINE.md config ladder)
+# ---------------------------------------------------------------------------
+
+PRESETS: Dict[str, Dict[str, Any]] = {
+    "gpt2-125m": dict(vocab_size=50257, hidden_size=768, intermediate_size=3072,
+                      num_layers=12, num_heads=12, max_seq_len=1024, norm="layernorm",
+                      activation="gelu", position="learned", tie_embeddings=True),
+    "llama3-8b": dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                      num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                      rope_theta=500000.0),
+    "llama3-70b": dict(vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+                       num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=8192,
+                       rope_theta=500000.0),
+    "mixtral-8x7b": dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                         num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=32768,
+                         num_experts=8, moe_top_k=2),
+    "tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+                 num_heads=4, max_seq_len=128),
+    "tiny-moe": dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+                     num_heads=4, max_seq_len=128, num_experts=4, moe_top_k=2),
+}
+
+
+def get_config(name: str, **overrides) -> TransformerConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; have {sorted(PRESETS)}")
+    kw = dict(PRESETS[name])
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(key, shape, in_axis_size, dtype):
+    scale = 1.0 / math.sqrt(in_axis_size)
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
+    """Create the parameter pytree. Per-layer weights are stacked on a leading
+    ``layers`` axis so the forward pass can ``lax.scan`` over them."""
+    pd = jnp.dtype(cfg.param_dtype)
+    h, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.kv_heads
+    keys = jax.random.split(rng, 16)
+
+    layer = {
+        "attn": {
+            "wq": _dense_init(keys[0], (L, h, nh * hd), h, pd),
+            "wk": _dense_init(keys[1], (L, h, nkv * hd), h, pd),
+            "wv": _dense_init(keys[2], (L, h, nkv * hd), h, pd),
+            "wo": _dense_init(keys[3], (L, nh * hd, h), nh * hd, pd),
+        },
+        "ln1": {"scale": jnp.ones((L, h), pd)},
+        "ln2": {"scale": jnp.ones((L, h), pd)},
+    }
+    if cfg.norm == "layernorm":
+        layer["ln1"]["bias"] = jnp.zeros((L, h), pd)
+        layer["ln2"]["bias"] = jnp.zeros((L, h), pd)
+
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        layer["moe"] = {
+            "router": _dense_init(keys[4], (L, h, E), h, pd),
+            "w_in": _dense_init(keys[5], (L, E, h, f), h, pd),
+            "w_gate": _dense_init(keys[6], (L, E, h, f), h, pd),
+            "w_out": _dense_init(keys[7], (L, E, f, h), f, pd),
+        }
+        if cfg.activation != "silu":
+            del layer["moe"]["w_gate"]
+    else:
+        mlp = {
+            "w_in": _dense_init(keys[5], (L, h, f), h, pd),
+            "w_out": _dense_init(keys[7], (L, f, h), f, pd),
+        }
+        if cfg.activation == "silu":
+            mlp["w_gate"] = _dense_init(keys[6], (L, h, f), h, pd)
+        layer["mlp"] = mlp
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": _dense_init(keys[8], (cfg.vocab_size, h), h, pd)},
+        "layers": layer,
+        "final_norm": {"scale": jnp.ones((h,), pd)},
+    }
+    if cfg.norm == "layernorm":
+        params["final_norm"]["bias"] = jnp.zeros((h,), pd)
+    if cfg.position == "learned":
+        params["embed"]["position"] = _dense_init(keys[9], (cfg.max_seq_len, h), h, pd)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"w": _dense_init(keys[10], (h, cfg.vocab_size), h, pd)}
+    return params
+
+
+def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Logical-axes pytree matching ``init_params`` output, consumed by
+    sharding rules (the zero.Init / AutoTP annotation surface)."""
+    ln = {"scale": ("layers", "embed")}
+    if cfg.norm == "layernorm":
+        ln = {"scale": ("layers", "embed"), "bias": ("layers", "embed")}
+    layer = {
+        "attn": {
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+        },
+        "ln1": dict(ln),
+        "ln2": dict(ln),
+    }
+    if cfg.num_experts > 0:
+        moe = {
+            "router": ("layers", "embed", None),
+            "w_in": ("layers", "expert", "embed", "mlp"),
+            "w_out": ("layers", "expert", "mlp", "embed"),
+        }
+        if cfg.activation == "silu":
+            moe["w_gate"] = ("layers", "expert", "embed", "mlp")
+        layer["moe"] = moe
+    else:
+        mlp = {"w_in": ("layers", "embed", "mlp"), "w_out": ("layers", "mlp", "embed")}
+        if cfg.activation == "silu":
+            mlp["w_gate"] = ("layers", "embed", "mlp")
+        layer["mlp"] = mlp
+
+    fn = {"scale": ("embed",)}
+    if cfg.norm == "layernorm":
+        fn["bias"] = ("embed",)
+    axes: Dict[str, Any] = {
+        "embed": {"tokens": ("vocab", "embed")},
+        "layers": layer,
+        "final_norm": fn,
+    }
+    if cfg.position == "learned":
+        axes["embed"]["position"] = ("seq", "embed")
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = {"w": ("embed", "vocab")}
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _norm(x, p, kind: str, eps: float):
+    if kind == "rmsnorm":
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        y = x * lax.rsqrt(var + eps).astype(x.dtype)
+        return y * p["scale"].astype(x.dtype)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True).astype(x.dtype)
+    y = (x - mean) * lax.rsqrt(var + eps).astype(x.dtype)
+    return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def rope_table(seq_len: int, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # (seq, head_dim/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, S, H, D). Rotates pairs (even, odd) of the head dim.
+    (TPU-equivalent of the reference's ``apply_rotary_pos_emb.cu``.)"""
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    o1 = x1 * c - x2 * s
+    o2 = x2 * c + x1 * s
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
+                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Reference einsum attention (B, S, H, D). GQA-aware."""
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    if KV != H:  # grouped-query: repeat kv heads
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        logits = jnp.where(seg, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+AttentionFn = Callable[..., jax.Array]
+
+
+def _attention_block(x, p, cfg: TransformerConfig, cos, sin, attn_fn: AttentionFn):
+    B, S, h = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    dt = x.dtype
+    q = (x @ p["wq"].astype(dt)).reshape(B, S, nh, hd)
+    k = (x @ p["wk"].astype(dt)).reshape(B, S, nkv, hd)
+    v = (x @ p["wv"].astype(dt)).reshape(B, S, nkv, hd)
+    if cfg.position == "rope":
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+    o = attn_fn(q, k, v, causal=True)
+    return o.reshape(B, S, nh * hd) @ p["wo"].astype(dt)
+
+
+def _mlp_block(x, p, cfg: TransformerConfig):
+    dt = x.dtype
+    if cfg.activation == "silu":
+        return (jax.nn.silu(x @ p["w_gate"].astype(dt)) * (x @ p["w_in"].astype(dt))) \
+            @ p["w_out"].astype(dt)
+    return jax.nn.gelu(x @ p["w_in"].astype(dt), approximate=True) @ p["w_out"].astype(dt)
+
+
+def _remat_policy(name: str):
+    pols = {
+        "everything": None,  # no remat
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        "dots_with_no_batch_dims_saveable":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if name not in pols:
+        raise ValueError(f"unknown remat policy {name!r}")
+    return pols[name]
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: TransformerConfig,
+            attn_fn: Optional[AttentionFn] = None,
+            moe_fn: Optional[Callable] = None) -> jax.Array:
+    """tokens (B, S) int32 → logits (B, S, V) in compute dtype.
+
+    ``attn_fn``/``moe_fn`` are injection points for Pallas flash attention,
+    Ulysses/ring sequence parallelism and expert-parallel MoE dispatch.
+    """
+    dt = jnp.dtype(cfg.dtype)
+    attn_fn = attn_fn or xla_attention
+    B, S = tokens.shape
+
+    x = params["embed"]["tokens"].astype(dt)[tokens]
+    if cfg.position == "learned":
+        x = x + params["embed"]["position"].astype(dt)[None, :S]
+    cos, sin = (None, None)
+    if cfg.position == "rope":
+        cos, sin = rope_table(S, cfg.head_dim, cfg.rope_theta)
+
+    def layer_body(carry, layer_params):
+        h = carry
+        a_in = _norm(h, layer_params["ln1"], cfg.norm, cfg.norm_eps)
+        h = h + _attention_block(a_in, layer_params["attn"], cfg, cos, sin, attn_fn)
+        m_in = _norm(h, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+        if cfg.num_experts > 0:
+            if moe_fn is None:
+                from ..moe.layer import dense_moe_block
+
+                h = h + dense_moe_block(m_in, layer_params["moe"], cfg)
+            else:
+                h = h + moe_fn(m_in, layer_params["moe"], cfg)
+        else:
+            h = h + _mlp_block(m_in, layer_params["mlp"], cfg)
+        return h, None
+
+    policy = _remat_policy(cfg.remat_policy)
+    body = layer_body
+    if policy is not None:
+        body = jax.checkpoint(layer_body, policy=policy, prevent_cse=False)
+
+    x, _ = lax.scan(body, x, params["layers"])
+
+    x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["tokens"].astype(dt).T
+    else:
+        logits = x @ params["lm_head"]["w"].astype(dt)
+    return logits
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array], cfg: TransformerConfig,
+            attn_fn: Optional[AttentionFn] = None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Causal-LM cross entropy. batch: {'input_ids': (B,S)}; optional
+    'labels' (shift done here when absent), optional 'loss_mask'."""
+    tokens = batch["input_ids"]
+    if "labels" in batch:
+        labels = batch["labels"]
+        logits = forward(params, tokens, cfg, attn_fn=attn_fn)
+    else:
+        labels = tokens[:, 1:]
+        logits = forward(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is None:
+        loss = nll.mean()
+        denom = nll.size
+    else:
+        mask = mask.astype(jnp.float32)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (nll * mask).sum() / denom
+    acc = (logits.argmax(-1) == labels).astype(jnp.float32).mean()
+    return loss, {"loss": loss, "accuracy": acc, "tokens": jnp.asarray(denom, jnp.float32)}

@@ -1,0 +1,257 @@
+"""Checkpoint save/load.
+
+Capability analogue of the reference's checkpoint stack: engine
+``save_checkpoint`` (engine.py:4557) / ``load_checkpoint`` (engine.py:4079),
+pluggable checkpoint engines (``runtime/checkpoint_engine/``), the ``latest``
+tag file, and tag-validation.  The on-disk layout is **universal by
+construction** (the reference needs an offline conversion step,
+``checkpoint/ds_to_universal.py``): every parameter and optimizer tensor is
+stored full (unsharded) under its pytree path, so a checkpoint written from
+any dp/fsdp/tp topology loads into any other — resharding happens at load
+time via ``device_put`` with the target sharding.
+
+Backends: ``native`` (safetensors files + msgpack metadata, async-capable)
+and ``orbax`` (for multi-host pods, reference's Nebula/DataStates role).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.logging import log_dist, logger
+
+_LATEST = "latest"
+_SAVE_LOCK = threading.Lock()
+_async_threads = []
+
+
+def _flatten_with_paths(tree: Any) -> Dict[str, Any]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        flat[key] = leaf
+    return flat
+
+
+def _save_tree(tree: Any, path: str) -> None:
+    """Write a pytree as a safetensors file + a structure descriptor."""
+    from safetensors.numpy import save_file
+
+    flat = _flatten_with_paths(tree)
+    arrays = {}
+    meta = {}
+    for k, v in flat.items():
+        arr = np.asarray(jax.device_get(v))
+        if arr.dtype == jnp.bfloat16:
+            meta[k] = "bfloat16"
+            arr = arr.view(np.uint16)
+        arrays[k] = arr
+    save_file(arrays, path, metadata={"bf16_keys": json.dumps(sorted(meta))})
+
+
+def _load_tree_flat(path: str) -> Dict[str, np.ndarray]:
+    from safetensors.numpy import load_file, safe_open
+
+    arrays = load_file(path)
+    with safe_open(path, framework="numpy") as f:
+        md = f.metadata() or {}
+    bf16_keys = set(json.loads(md.get("bf16_keys", "[]")))
+    for k in bf16_keys:
+        arrays[k] = arrays[k].view(jnp.bfloat16)
+    return arrays
+
+
+def _unflatten_like(template: Any, flat: Dict[str, np.ndarray]) -> Any:
+    paths = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing tensor {key!r}")
+        leaves.append(flat[key])
+    return jax.tree_util.tree_unflatten(jax.tree.structure(template), leaves)
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[Dict] = None) -> str:
+    """Write model+optimizer+engine state. Only process 0 writes in the
+    single-controller case; multi-host uses the orbax backend."""
+    cfg = engine.config.checkpoint
+    tag = tag or f"global_step{int(engine.state.step)}"
+    ckpt_dir = os.path.join(save_dir, tag)
+
+    if cfg.engine == "orbax":
+        return _save_orbax(engine, save_dir, tag)
+
+    state = engine.state
+
+    if jax.process_index() != 0:
+        return ckpt_dir
+
+    # Snapshot to host SYNCHRONOUSLY: the next train step donates the current
+    # state's device buffers, so the device_get must happen before this
+    # function returns, never inside the background thread.
+    host_params = jax.device_get(state.params)
+    host_opt = jax.device_get(state.opt_state)
+    meta = {
+        "step": int(state.step),
+        "skipped_steps": int(state.skipped_steps),
+        "loss_scale": float(state.loss_scale.scale),
+        "loss_scale_good_steps": int(state.loss_scale.good_steps),
+        "loss_scale_hysteresis": int(state.loss_scale.hysteresis),
+        "rng": np.asarray(jax.device_get(state.rng)).tolist(),
+        "zero_stage": engine.zero_stage,
+        "world_size": engine.topo.world_size,
+        "client_state": client_state or {},
+        "framework_version": _version(),
+    }
+
+    def _do_save():
+        with _SAVE_LOCK:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            _save_tree(host_params, os.path.join(ckpt_dir, "model.safetensors"))
+            _save_tree(host_opt, os.path.join(ckpt_dir, "optimizer.safetensors"))
+            with open(os.path.join(ckpt_dir, "engine_state.json"), "w") as f:
+                json.dump(meta, f, indent=2)
+            with open(os.path.join(save_dir, _LATEST), "w") as f:
+                f.write(tag)
+            log_dist(f"saved checkpoint {ckpt_dir}")
+            _prune_old(save_dir, cfg.keep_n_latest)
+
+    if cfg.async_save:
+        # decoupled checkpoint engine (reference: decoupled_checkpoint_engine.py):
+        # the host snapshot is complete, only file IO runs off-thread.
+        t = threading.Thread(target=_do_save, daemon=False)
+        t.start()
+        _async_threads.append(t)
+    else:
+        _do_save()
+    return ckpt_dir
+
+
+def wait_for_async_saves() -> None:
+    for t in _async_threads:
+        t.join()
+    _async_threads.clear()
+
+
+import atexit  # noqa: E402  (registration kept beside the definition)
+
+atexit.register(wait_for_async_saves)
+
+
+def _prune_old(save_dir: str, keep: Optional[int]) -> None:
+    if not keep:
+        return
+    tags = sorted(
+        (d for d in os.listdir(save_dir)
+         if os.path.isdir(os.path.join(save_dir, d)) and d.startswith("global_step")),
+        key=lambda d: int(d.removeprefix("global_step")))
+    for d in tags[:-keep]:
+        import shutil
+
+        shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True,
+                    ) -> Tuple[Optional[str], Dict]:
+    """Load into the engine, resharding to the engine's current topology
+    (the universal-checkpoint property).
+
+    ``load_optimizer_states=False`` (reference: ``engine.load_checkpoint``
+    kwarg) keeps the engine's fresh optimizer state — required when the
+    optimizer config (and hence state structure) changed between save and load.
+    """
+    from ..loss_scaler import LossScaleState
+
+    if tag is None:
+        latest = os.path.join(load_dir, _LATEST)
+        if not os.path.exists(latest):
+            logger.warning(f"no {_LATEST} file in {load_dir}")
+            return None, {}
+        tag = open(latest).read().strip()
+    ckpt_dir = os.path.join(load_dir, tag)
+    if not os.path.isdir(ckpt_dir):
+        raise FileNotFoundError(f"checkpoint dir not found: {ckpt_dir}")
+
+    with open(os.path.join(ckpt_dir, "engine_state.json")) as f:
+        meta = json.load(f)
+    _validate_tag(engine, meta)
+
+    flat_params = _load_tree_flat(os.path.join(ckpt_dir, "model.safetensors"))
+    params = _unflatten_like(engine.state.params, flat_params)
+    params = jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s.sharding),
+                          params, engine.state.params)
+
+    if load_optimizer_states:
+        flat_opt = _load_tree_flat(os.path.join(ckpt_dir, "optimizer.safetensors"))
+        try:
+            opt_state = _unflatten_like(engine.state.opt_state, flat_opt)
+        except KeyError as e:
+            raise ValueError(
+                f"optimizer state in {ckpt_dir} does not match the engine's "
+                f"optimizer structure ({e}); if the optimizer config changed, "
+                "pass load_optimizer_states=False") from e
+        opt_state = jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s.sharding),
+                                 opt_state, engine.state.opt_state)
+    else:
+        opt_state = engine.state.opt_state
+
+    from ..engine import EngineState
+
+    engine.state = EngineState(
+        step=jnp.asarray(meta["step"], jnp.int32),
+        params=params,
+        opt_state=opt_state,
+        loss_scale=LossScaleState(
+            scale=jnp.asarray(meta["loss_scale"], jnp.float32),
+            good_steps=jnp.asarray(meta["loss_scale_good_steps"], jnp.int32),
+            hysteresis=jnp.asarray(meta["loss_scale_hysteresis"], jnp.int32),
+        ),
+        rng=jnp.asarray(np.array(meta["rng"], dtype=np.uint32)),
+        skipped_steps=jnp.asarray(meta["skipped_steps"], jnp.int32),
+    )
+    engine.global_steps = meta["step"]
+    log_dist(f"loaded checkpoint {ckpt_dir} (step {meta['step']})")
+    return ckpt_dir, meta.get("client_state", {})
+
+
+def _validate_tag(engine, meta: Dict) -> None:
+    """Reference: ``_checkpoint_tag_validation`` (engine.py:4540)."""
+    mode = engine.config.checkpoint.tag_validation.lower()
+    if mode == "ignore":
+        return
+    if meta.get("zero_stage") != engine.zero_stage:
+        msg = (f"checkpoint zero_stage={meta.get('zero_stage')} != "
+               f"engine zero_stage={engine.zero_stage} (universal layout: "
+               "load proceeds; optimizer sharding is recomputed)")
+        if mode == "fail":
+            raise ValueError(msg)
+        logger.warning(msg)
+
+
+def _save_orbax(engine, save_dir: str, tag: str) -> str:  # pragma: no cover
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(os.path.abspath(save_dir), tag)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path + "/state", engine.state)
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        with open(os.path.join(save_dir, _LATEST), "w") as f:
+            f.write(tag)
+    return path
+
+
+def _version() -> str:
+    from ... import __version__
+
+    return __version__

@@ -1,0 +1,82 @@
+"""Data loading.
+
+Capability analogue of the reference's ``DeepSpeedDataLoader``
+(``runtime/dataloader.py``, wired in ``engine.deepspeed_io``) and
+``RepeatingLoader``.  TPU-native: batches are host numpy arrays that the
+engine places sharded over the (dp, fsdp) batch axis; in multi-host runs each
+process supplies only its local shard
+(``jax.make_array_from_process_local_data``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+import numpy as np
+
+
+class DeepSpeedDataLoader:
+    """Wraps an indexable dataset of dict-like examples into global batches.
+
+    ``dataset`` may be: a dict of arrays (column store), a sequence of dict
+    examples, or any object with ``__len__`` and ``__getitem__``.
+    """
+
+    def __init__(self, dataset: Any, batch_size: int, shuffle: bool = True,
+                 seed: int = 0, drop_last: bool = True,
+                 collate_fn: Optional[Callable] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn
+        self._rng = np.random.default_rng(seed)
+        self._columnar = isinstance(dataset, dict)
+
+    def __len__(self) -> int:
+        n = (len(next(iter(self.dataset.values()))) if self._columnar
+             else len(self.dataset))
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _num_examples(self) -> int:
+        return (len(next(iter(self.dataset.values()))) if self._columnar
+                else len(self.dataset))
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        n = self._num_examples()
+        order = np.arange(n)
+        if self.shuffle:
+            self._rng.shuffle(order)
+        nb = len(self)
+        for b in range(nb):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            if self._columnar:
+                batch = {k: np.asarray(v)[idx] for k, v in self.dataset.items()}
+            else:
+                examples = [self.dataset[int(i)] for i in idx]
+                if self.collate_fn:
+                    batch = self.collate_fn(examples)
+                else:
+                    batch = {k: np.stack([e[k] for e in examples])
+                             for k in examples[0]}
+            yield batch
+
+
+class RepeatingLoader:
+    """Reference: ``runtime/dataloader.py RepeatingLoader`` — infinite cycle."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self._it = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._it = iter(self.loader)
+            return next(self._it)

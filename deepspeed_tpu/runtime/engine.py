@@ -1,0 +1,432 @@
+"""The training engine.
+
+Capability analogue of the reference's ``runtime/engine.py``
+(``DeepSpeedEngine:235`` — forward:2675 / backward:3066 / step:3241) with a
+functional core: one jitted ``train_step`` that fuses forward, backward,
+gradient accumulation, ZeRO-sharded reduction, loss scaling, clipping and the
+optimizer update into a single XLA program.  The imperative DeepSpeed surface
+(``engine.train_batch``, ``save_checkpoint`` …) is a thin shell holding the
+current ``TrainState``.
+
+Where the reference hand-schedules overlap (IPG buckets, side streams,
+`stage_1_and_2.py:1125`), here the schedule is emergent: gradients carry the
+optimizer-state sharding, so XLA lowers the DP reduction to
+reduce-scatter + sharded update + all-gather — ZeRO-1/2 — and stage-3 param
+sharding makes the per-layer all-gathers part of the scanned program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import comm
+from ..accelerator import get_accelerator
+from ..parallel.topology import MeshTopology, set_topology
+from ..utils.logging import log_dist, logger
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from .config import DeepSpeedTPUConfig, ResolvedBatchConfig
+from .config_utils import ConfigError
+from .loss_scaler import (LossScaleState, grads_finite, init_loss_scale,
+                          scale_loss, unscale_grads, update_loss_scale)
+from .lr_schedules import create_scheduler
+from .optimizers import create_optimizer, default_weight_decay_mask
+from .zero.sharding import (rules_for_optimizer, rules_for_params,
+                            sharding_for_tree)
+
+LossFn = Callable[..., Tuple[jax.Array, Dict[str, jax.Array]]]
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """What the engine needs from a model: pure functions + annotated params.
+
+    ``loss_fn(params, batch, rng) -> (loss, metrics_dict)`` must be jittable.
+    ``param_axes`` is the logical-axes pytree (may be a prefix tree / None).
+    """
+
+    loss_fn: LossFn
+    params: Any
+    param_axes: Any = None
+    # optional extra aux-loss fn (e.g. MoE router losses already inside loss_fn)
+    eval_fn: Optional[LossFn] = None
+    flops_per_token: Optional[float] = None
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class EngineState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    loss_scale: LossScaleState
+    rng: jax.Array
+    skipped_steps: jax.Array
+
+    def tree_flatten(self):
+        return ((self.step, self.params, self.opt_state, self.loss_scale,
+                 self.rng, self.skipped_steps), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+class TrainingEngine:
+    """Reference: ``DeepSpeedEngine``.  Owns topology, shardings, the jitted
+    step, checkpoint IO, timers and monitoring."""
+
+    def __init__(self, model: ModelSpec, config: DeepSpeedTPUConfig,
+                 topo: Optional[MeshTopology] = None):
+        self.config = config
+        self.accelerator = get_accelerator()
+        self.model = model
+
+        # ---- topology -------------------------------------------------
+        if topo is None:
+            mesh_cfg = config.mesh
+            if config.zero_optimization.stage >= 3:
+                # ZeRO-3 shards params over the whole DP world: fold dp→fsdp
+                from .config import MeshConfig
+                from .config_utils import is_auto
+
+                if is_auto(mesh_cfg.fsdp_size) or int(mesh_cfg.fsdp_size) == 1:
+                    mesh_cfg = MeshConfig(**{
+                        **mesh_cfg.model_dump(),
+                        "fsdp_size": "auto", "data_parallel_size": 1})
+            topo = MeshTopology.from_config(mesh_cfg)
+        self.topo = topo
+        set_topology(topo)
+
+        # ---- batch math ----------------------------------------------
+        self.batch_config: ResolvedBatchConfig = config.resolve_batch_config(
+            topo.dp_world_size)
+
+        # ---- precision ------------------------------------------------
+        self.compute_dtype = jnp.dtype(config.compute_dtype)
+        self.fp16_enabled = config.fp16.enabled is True
+
+        # ---- sharding rules ------------------------------------------
+        stage = config.zero_optimization.stage
+        self.zero_stage = stage
+        self.param_rules = rules_for_params(stage, topo)
+        self.opt_rules = rules_for_optimizer(stage, topo)
+        self.param_shardings = sharding_for_tree(
+            model.params, model.param_axes, self.param_rules, topo)
+        # param-shaped leaves of the optimizer state (and stage≥2 gradients)
+        # follow the optimizer rules — computed once, reused everywhere
+        self.opt_param_shardings = sharding_for_tree(
+            model.params, model.param_axes, self.opt_rules, topo)
+
+        # ---- optimizer ------------------------------------------------
+        base_lr = config.optimizer.params.get("lr", 1e-3)
+        self.lr_schedule = create_scheduler(config.scheduler, base_lr=base_lr)
+        wd_mask = None
+        if config.optimizer.params.get("weight_decay", 0.0):
+            wd_mask = default_weight_decay_mask(model.params)
+        chain = []
+        if config.gradient_clipping and config.gradient_clipping > 0:
+            chain.append(optax.clip_by_global_norm(config.gradient_clipping))
+        chain.append(create_optimizer(config.optimizer, self.lr_schedule, wd_mask))
+        self.optimizer = optax.chain(*chain)
+
+        # ---- state init (sharded at construction) ---------------------
+        self.opt_shardings = None  # set inside _init_state
+        self.state = self._init_state()
+
+        # ---- step function -------------------------------------------
+        self._train_step = self._build_train_step()
+        self._eval_step = self._build_eval_step()
+
+        # ---- observability -------------------------------------------
+        self.timers = SynchronizedWallClockTimer(synchronize=config.wall_clock_breakdown)
+        self.tput = ThroughputTimer(batch_size=self.batch_config.train_batch_size,
+                                    steps_per_output=config.steps_per_print)
+        self.monitor = self._configure_monitor()
+        self.global_steps = 0
+        log_dist(f"engine ready: zero_stage={stage} topo={topo} "
+                 f"batch={self.batch_config.train_batch_size} "
+                 f"micro={self.batch_config.micro_batch_size_per_device} "
+                 f"gas={self.batch_config.gradient_accumulation_steps} "
+                 f"dtype={self.compute_dtype}")
+
+    # ------------------------------------------------------------------
+    # setup helpers
+    # ------------------------------------------------------------------
+
+    def _configure_monitor(self):
+        from ..monitor.monitor import MonitorMaster
+
+        return MonitorMaster(self.config)
+
+    def _opt_state_shardings(self, params_sharded):
+        """Sharding tree for the optimizer state: param-like leaves get the
+        *optimizer* rules (ZeRO-1/2 shard them over dp even when params are
+        replicated); scalar counters replicate."""
+        state_shape = jax.eval_shape(self.optimizer.init, params_sharded)
+        replicated = NamedSharding(self.topo.mesh, P())
+
+        return optax.tree_map_params(
+            self.optimizer,
+            lambda _leaf, shard: shard,
+            state_shape,
+            self.opt_param_shardings,
+            transform_non_params=lambda _leaf: replicated,
+        )
+
+    def _init_state(self) -> EngineState:
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s),
+            self.model.params, self.param_shardings)
+        opt_shardings = self._opt_state_shardings(params)
+        self.opt_shardings = opt_shardings
+        opt_state = jax.jit(self.optimizer.init,
+                            out_shardings=opt_shardings)(params)
+        if self.fp16_enabled:
+            ls = init_loss_scale(
+                initial_scale_power=self.config.fp16.initial_scale_power,
+                hysteresis=self.config.fp16.hysteresis,
+                static_scale=self.config.fp16.loss_scale,
+            )
+        else:
+            ls = init_loss_scale(static_scale=1.0)
+        return EngineState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            loss_scale=ls,
+            rng=jax.random.PRNGKey(self.config.seed),
+            skipped_steps=jnp.zeros((), jnp.int32),
+        )
+
+    # ------------------------------------------------------------------
+    # the jitted step
+    # ------------------------------------------------------------------
+
+    def _build_train_step(self):
+        cfg = self.config
+        gas = self.batch_config.gradient_accumulation_steps
+        loss_fn = self.model.loss_fn
+        optimizer = self.optimizer
+        fp16 = self.fp16_enabled
+        dynamic = cfg.fp16.dynamic_loss_scale if fp16 else False
+        opt_param_shardings = self.opt_param_shardings
+
+        def microbatch_grads(params, mb, rng, ls_state):
+            def scaled_loss(p):
+                loss, metrics = loss_fn(p, mb, rng)
+                return scale_loss(loss, ls_state) if fp16 else loss, metrics
+
+            (loss, metrics), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(params)
+            return loss, metrics, grads
+
+        def step_fn(state: EngineState, batch: Dict[str, jax.Array]):
+            rng, step_rng = jax.random.split(state.rng)
+
+            # --- grad accumulation over the leading gas axis -----------
+            def accum(carry, mb):
+                grads_acc, metrics_acc = carry
+                _, metrics, grads = microbatch_grads(
+                    state.params, mb, step_rng, state.loss_scale)
+                grads = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                     grads_acc, grads)
+                metrics_acc = jax.tree.map(lambda a, m: a + m.astype(jnp.float32),
+                                           metrics_acc, metrics)
+                return (grads, metrics_acc), None
+
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            # metrics pytree mirrors whatever the user's loss_fn returns
+            one_mb = jax.tree.map(lambda x: x[0], batch)
+            _, metrics_shape = jax.eval_shape(
+                lambda p, b: loss_fn(p, b, step_rng), state.params, one_mb)
+            zero_metrics = jax.tree.map(
+                lambda s: jnp.zeros((), jnp.float32), metrics_shape)
+            if gas > 1:
+                (grads, msum), _ = jax.lax.scan(accum, (zero_grads, zero_metrics), batch)
+            else:
+                one = jax.tree.map(lambda x: x[0], batch)
+                (grads, msum), _ = accum((zero_grads, zero_metrics), one)
+            metrics = jax.tree.map(lambda m: m / gas, msum)
+
+            # --- unscale + average ------------------------------------
+            scale_div = float(gas)
+            grads = jax.tree.map(lambda g: g / scale_div, grads)
+            if fp16:
+                grads = unscale_grads(grads, state.loss_scale)
+
+            # ZeRO-2/3: constrain grads to the optimizer-state sharding →
+            # XLA reduce-scatters instead of all-reducing.
+            if self.zero_stage >= 2:
+                grads = jax.tree.map(
+                    lambda g, s: jax.lax.with_sharding_constraint(g, s),
+                    grads, opt_param_shardings)
+
+            finite = grads_finite(grads) if fp16 else jnp.array(True)
+            grad_norm = optax.global_norm(grads)
+
+            # --- optimizer update (skipped on overflow) ----------------
+            def do_update(operand):
+                params, opt_state, grads = operand
+                updates, new_opt = optimizer.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                return new_params, new_opt
+
+            def skip_update(operand):
+                params, opt_state, _ = operand
+                return params, opt_state
+
+            if fp16:
+                new_params, new_opt = jax.lax.cond(
+                    finite, do_update, skip_update,
+                    (state.params, state.opt_state, grads))
+                new_ls = update_loss_scale(
+                    state.loss_scale, finite,
+                    loss_scale_window=cfg.fp16.loss_scale_window,
+                    min_scale=cfg.fp16.min_loss_scale,
+                    hysteresis=cfg.fp16.hysteresis,
+                    dynamic=dynamic)
+                skipped = state.skipped_steps + jnp.where(finite, 0, 1)
+            else:
+                new_params, new_opt = do_update((state.params, state.opt_state, grads))
+                new_ls = state.loss_scale
+                skipped = state.skipped_steps
+
+            # Pin the new state to its canonical shardings: prevents GSPMD
+            # placement drift across steps (e.g. stage-1 params must come back
+            # replicated — the all-gather after the sharded update IS ZeRO-1's
+            # schedule) and keeps eval/checkpoint numerics placement-stable.
+            new_params = jax.tree.map(jax.lax.with_sharding_constraint,
+                                      new_params, self.param_shardings)
+            new_opt = jax.tree.map(
+                lambda x, s: jax.lax.with_sharding_constraint(x, s),
+                new_opt, self.opt_shardings)
+            new_state = EngineState(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt,
+                loss_scale=new_ls,
+                rng=rng,
+                skipped_steps=skipped,
+            )
+            metrics = dict(metrics)
+            metrics["grad_norm"] = grad_norm
+            metrics["loss_scale"] = state.loss_scale.scale
+            # effective update count = step - skipped: matches both the optax
+            # counter (which doesn't advance on overflow-skipped steps) and
+            # the reference's "scheduler not stepped on overflow" behavior
+            metrics["lr"] = jnp.asarray(
+                self.lr_schedule(state.step - state.skipped_steps), jnp.float32)
+            metrics["overflow"] = (~finite).astype(jnp.float32)
+            return new_state, metrics
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def _build_eval_step(self):
+        loss_fn = self.model.eval_fn or self.model.loss_fn
+
+        def eval_fn(state: EngineState, batch):
+            _, metrics = loss_fn(state.params, batch, state.rng)
+            return metrics
+
+        return jax.jit(eval_fn)
+
+    # ------------------------------------------------------------------
+    # data placement
+    # ------------------------------------------------------------------
+
+    def _place_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        """Reshape a global batch (train_batch, ...) → (gas, micro_global, ...)
+        and place it sharded over (dp, fsdp) on the batch axis."""
+        gas = self.batch_config.gradient_accumulation_steps
+        tb = self.batch_config.train_batch_size
+
+        def place(x):
+            x = np.asarray(x)
+            if x.shape[0] != tb:
+                raise ConfigError(
+                    f"batch leading dim {x.shape[0]} != train_batch_size {tb}")
+            x = x.reshape((gas, tb // gas) + x.shape[1:])
+            sharding = NamedSharding(self.topo.mesh,
+                                     P(None, ("dp", "fsdp")))
+            return jax.device_put(x, sharding)
+
+        return jax.tree.map(place, batch)
+
+    # ------------------------------------------------------------------
+    # public API (reference surface)
+    # ------------------------------------------------------------------
+
+    def train_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """One full global-batch step (fwd+bwd+opt).  Reference:
+        ``PipelineEngine.train_batch`` / engine forward+backward+step."""
+        self.tput.start()
+        placed = self._place_batch(batch)
+        self.state, metrics = self._train_step(self.state, placed)
+        self.global_steps += 1
+        out = {k: float(v) for k, v in metrics.items()}
+        self.tput.stop()
+        self._write_monitor(out)
+        if self.config.steps_per_print and \
+                self.global_steps % self.config.steps_per_print == 0:
+            log_dist(f"step={self.global_steps} loss={out['loss']:.4f} "
+                     f"lr={out['lr']:.2e} grad_norm={out.get('grad_norm', 0.0):.3f}")
+        return out
+
+    def eval_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        placed = self._place_batch(batch)
+        flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), placed)
+        metrics = self._eval_step(self.state, flat)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def _write_monitor(self, metrics: Dict[str, float]) -> None:
+        if self.monitor.enabled:
+            events = [(f"Train/{k}", v, self.global_steps) for k, v in metrics.items()]
+            self.monitor.write_events(events)
+
+    # -- state accessors (reference: engine property surface) -----------
+
+    @property
+    def train_batch_size(self) -> int:
+        return self.batch_config.train_batch_size
+
+    @property
+    def train_micro_batch_size_per_device(self) -> int:
+        return self.batch_config.micro_batch_size_per_device
+
+    @property
+    def gradient_accumulation_steps(self) -> int:
+        return self.batch_config.gradient_accumulation_steps
+
+    def get_lr(self) -> float:
+        return float(self.lr_schedule(self.state.step - self.state.skipped_steps))
+
+    def get_global_step(self) -> int:
+        return int(self.state.step)
+
+    def get_loss_scale(self) -> float:
+        return float(self.state.loss_scale.scale)
+
+    # -- checkpointing ---------------------------------------------------
+
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[Dict] = None) -> str:
+        from .checkpoint.engine import save_checkpoint as _save
+
+        return _save(self, save_dir, tag=tag, client_state=client_state or {})
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_optimizer_states: bool = True,
+                        ) -> Tuple[Optional[str], Dict]:
+        from .checkpoint.engine import load_checkpoint as _load
+
+        return _load(self, load_dir, tag=tag,
+                     load_optimizer_states=load_optimizer_states)

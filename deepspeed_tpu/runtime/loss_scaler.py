@@ -1,0 +1,79 @@
+"""fp16 loss scaling, functional.
+
+Capability analogue of the reference's ``runtime/fp16/loss_scaler.py``
+(``LossScaler:163`` static, ``DynamicLossScaler:187``) — but as pure state
+transitions living inside the jitted train step.  The collective-coupled
+overflow check (`stage_1_and_2.py:2393 has_overflow`) becomes a ``psum`` of a
+local isfinite flag, which XLA folds into the gradient reduction schedule.
+
+bf16 is the TPU-preferred path and needs none of this; fp16 is kept for
+capability parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array  # current loss scale (f32 scalar)
+    good_steps: jax.Array  # consecutive overflow-free steps (i32)
+    hysteresis: jax.Array  # remaining overflow tolerance (i32)
+
+
+def init_loss_scale(initial_scale_power: int = 16, hysteresis: int = 2,
+                    static_scale: float = 0.0) -> LossScaleState:
+    scale = static_scale if static_scale > 0 else float(2 ** initial_scale_power)
+    return LossScaleState(
+        scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.zeros((), jnp.int32),
+        hysteresis=jnp.asarray(hysteresis, jnp.int32),
+    )
+
+
+def grads_finite(grads: Any) -> jax.Array:
+    leaves = jax.tree.leaves(grads)
+    finite = jnp.array(True)
+    for g in leaves:
+        finite &= jnp.all(jnp.isfinite(g))
+    return finite
+
+
+def update_loss_scale(state: LossScaleState, finite: jax.Array,
+                      loss_scale_window: int = 1000, min_scale: float = 1.0,
+                      hysteresis: int = 2, dynamic: bool = True,
+                      scale_factor: float = 2.0) -> LossScaleState:
+    """Dynamic loss-scale transition (reference DynamicLossScaler.update_scale)."""
+    if not dynamic:
+        return state
+
+    def on_overflow(s: LossScaleState) -> LossScaleState:
+        hys = s.hysteresis - 1
+        new_scale = jnp.where(hys <= 0,
+                              jnp.maximum(s.scale / scale_factor, min_scale),
+                              s.scale)
+        new_hys = jnp.where(hys <= 0, jnp.asarray(hysteresis, jnp.int32), hys)
+        return LossScaleState(new_scale, jnp.zeros((), jnp.int32), new_hys)
+
+    def on_good(s: LossScaleState) -> LossScaleState:
+        good = s.good_steps + 1
+        grow = good >= loss_scale_window
+        return LossScaleState(
+            jnp.where(grow, s.scale * scale_factor, s.scale),
+            jnp.where(grow, 0, good),
+            jnp.asarray(hysteresis, jnp.int32),
+        )
+
+    return jax.lax.cond(finite, on_good, on_overflow, state)
+
+
+def scale_loss(loss: jax.Array, state: LossScaleState) -> jax.Array:
+    return loss * state.scale.astype(loss.dtype)
+
+
+def unscale_grads(grads: Any, state: LossScaleState) -> Any:
+    inv = (1.0 / state.scale).astype(jnp.float32)
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * inv), grads)

@@ -1,0 +1,3 @@
+from .schedules import create_scheduler, SCHEDULES
+
+__all__ = ["create_scheduler", "SCHEDULES"]

@@ -1,0 +1,141 @@
+"""LR schedules.
+
+Capability analogue of the reference's ``deepspeed/runtime/lr_schedules.py``:
+WarmupLR, WarmupDecayLR, WarmupCosineLR, OneCycle, LRRangeTest — implemented
+as optax schedule functions (step → lr) so they inject directly into the
+jitted update.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict
+
+import optax
+
+from ..config import SchedulerConfig
+from ..config_utils import ConfigError
+
+Schedule = Callable[[Any], Any]
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log", **_) -> Schedule:
+    """Reference WarmupLR: warm from min→max then hold."""
+    import jax.numpy as jnp
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        t = jnp.clip(step / max(warmup_num_steps, 1), 0.0, 1.0)
+        if warmup_type == "log":
+            # log-space interpolation (matches reference's log warmup)
+            frac = jnp.where(t > 0, jnp.log1p(t * (math.e - 1.0)), 0.0)
+        else:
+            frac = t
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
+
+    return sched
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "linear", **_) -> Schedule:
+    """Warmup then linear decay to 0 over total_num_steps."""
+    import jax.numpy as jnp
+
+    warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip(
+            (total_num_steps - step) / max(total_num_steps - warmup_num_steps, 1),
+            0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, warm(step), warmup_max_lr * decay)
+
+    return sched
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_max_lr: float = 0.001, **_) -> Schedule:
+    import jax.numpy as jnp
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm_frac = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(
+            step / max(warmup_num_steps, 1), 0.0, 1.0)
+        prog = jnp.clip((step - warmup_num_steps) /
+                        max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0)
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(math.pi * prog))
+        ratio = jnp.where(step < warmup_num_steps, warm_frac, cos)
+        return warmup_max_lr * ratio
+
+    return sched
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float, cycle_first_step_size: int = 2000,
+              cycle_second_step_size: int = None, decay_step_size: int = 0,
+              decay_lr_rate: float = 0.0, **_) -> Schedule:
+    """Reference OneCycle (lr triangle then optional decay)."""
+    import jax.numpy as jnp
+
+    second = cycle_second_step_size or cycle_first_step_size
+    total = cycle_first_step_size + second
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (step / cycle_first_step_size)
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * (
+            (step - cycle_first_step_size) / second)
+        in_cycle = jnp.where(step < cycle_first_step_size, up, jnp.maximum(down, cycle_min_lr))
+        if decay_step_size > 0:
+            decayed = cycle_min_lr * (decay_lr_rate ** ((step - total) / decay_step_size))
+            return jnp.where(step <= total, in_cycle, jnp.maximum(decayed, 0.0))
+        return in_cycle
+
+    return sched
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False, **_) -> Schedule:
+    import jax.numpy as jnp
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1 + interval * lr_range_test_step_rate)
+
+    return sched
+
+
+def constant(lr: float = 0.001, **_) -> Schedule:
+    def sched(step):
+        return lr
+
+    return sched
+
+
+SCHEDULES: Dict[str, Callable[..., Schedule]] = {
+    "warmuplr": warmup_lr,
+    "warmupdecaylr": warmup_decay_lr,
+    "warmupcosinelr": warmup_cosine_lr,
+    "onecycle": one_cycle,
+    "lrrangetest": lr_range_test,
+    "constant": constant,
+}
+
+
+def create_scheduler(cfg: SchedulerConfig, base_lr: float = 0.001) -> Schedule:
+    if cfg.type is None:
+        return constant(lr=base_lr)
+    key = cfg.type.lower().replace("_", "")
+    if key not in SCHEDULES:
+        raise ConfigError(f"unknown scheduler {cfg.type!r}; have {sorted(SCHEDULES)}")
+    params = dict(cfg.params)
+    # reference convention: WarmupLR defaults max lr to optimizer lr
+    if key.startswith("warmup"):
+        params.setdefault("warmup_max_lr", base_lr)
+    return SCHEDULES[key](**params)

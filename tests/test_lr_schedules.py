@@ -1,0 +1,67 @@
+"""LR schedule tests (reference: tests/unit/runtime/test_lr_schedulers.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.config import SchedulerConfig
+from deepspeed_tpu.runtime.config_utils import ConfigError
+from deepspeed_tpu.runtime.lr_schedules import create_scheduler
+
+
+def _lr(sched, step):
+    return float(sched(step))
+
+
+def test_warmup_lr():
+    s = create_scheduler(SchedulerConfig(type="WarmupLR", params={
+        "warmup_min_lr": 0.0, "warmup_max_lr": 0.01, "warmup_num_steps": 100,
+        "warmup_type": "linear"}))
+    assert _lr(s, 0) == 0.0
+    assert abs(_lr(s, 50) - 0.005) < 1e-6
+    assert abs(_lr(s, 100) - 0.01) < 1e-6
+    assert abs(_lr(s, 1000) - 0.01) < 1e-6  # holds after warmup
+
+
+def test_warmup_decay_lr():
+    s = create_scheduler(SchedulerConfig(type="WarmupDecayLR", params={
+        "total_num_steps": 1000, "warmup_max_lr": 0.01, "warmup_num_steps": 100,
+        "warmup_type": "linear"}))
+    assert abs(_lr(s, 100) - 0.01) < 1e-6
+    assert _lr(s, 550) == pytest.approx(0.005, rel=1e-3)
+    assert _lr(s, 1000) == pytest.approx(0.0, abs=1e-8)
+
+
+def test_warmup_cosine_lr():
+    s = create_scheduler(SchedulerConfig(type="WarmupCosineLR", params={
+        "total_num_steps": 1000, "warmup_num_steps": 100,
+        "warmup_max_lr": 0.01}))
+    mid = _lr(s, 550)
+    assert 0 < _lr(s, 999) < mid < _lr(s, 100)
+
+
+def test_one_cycle():
+    s = create_scheduler(SchedulerConfig(type="OneCycle", params={
+        "cycle_min_lr": 0.001, "cycle_max_lr": 0.01,
+        "cycle_first_step_size": 100}))
+    assert _lr(s, 0) == pytest.approx(0.001)
+    assert _lr(s, 100) == pytest.approx(0.01)
+    assert _lr(s, 200) == pytest.approx(0.001)
+
+
+def test_lr_range_test():
+    s = create_scheduler(SchedulerConfig(type="LRRangeTest", params={
+        "lr_range_test_min_lr": 0.001, "lr_range_test_step_size": 100,
+        "lr_range_test_step_rate": 1.0}))
+    assert _lr(s, 0) == pytest.approx(0.001)
+    assert _lr(s, 100) == pytest.approx(0.002)
+
+
+def test_unknown_scheduler():
+    with pytest.raises(ConfigError):
+        create_scheduler(SchedulerConfig(type="Bogus"))
+
+
+def test_none_scheduler_constant():
+    s = create_scheduler(SchedulerConfig(), base_lr=3e-4)
+    assert _lr(s, 0) == pytest.approx(3e-4)
+    assert _lr(s, 10**6) == pytest.approx(3e-4)
