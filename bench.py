@@ -33,8 +33,9 @@ def main() -> None:
         cfg = tfm.get_config(
             "llama3-8b", num_layers=12, hidden_size=2048,
             intermediate_size=5632, num_heads=16, num_kv_heads=8,
-            vocab_size=32000, max_seq_len=2048, param_dtype="bfloat16")
-        micro, seq, steps, warmup = 4, 2048, 10, 3
+            vocab_size=32000, max_seq_len=2048, param_dtype="bfloat16",
+            attn_impl="flash")
+        micro, seq, steps, warmup = 8, 2048, 10, 3
     else:  # CI smoke path
         cfg = tfm.get_config("tiny")
         micro, seq, steps, warmup = 2, 128, 3, 1

@@ -289,6 +289,31 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
 AttentionFn = Callable[..., jax.Array]
 
 
+def resolve_attention(impl: str) -> AttentionFn:
+    """Select the attention implementation by name.
+
+    'xla'     — einsum reference path (always correct, any shape)
+    'flash'   — Pallas fused kernel (ops/pallas/flash_attention.py)
+    'ulysses' — all-to-all sequence parallelism over the sp axis
+    'ring'    — ring attention (blockwise, ppermute over the sp axis)
+    """
+    if impl == "xla":
+        return xla_attention
+    if impl == "flash":
+        from ..ops.pallas.flash_attention import flash_attention
+
+        return flash_attention
+    if impl == "ulysses":
+        from ..sequence.ulysses import ulysses_attention
+
+        return ulysses_attention
+    if impl == "ring":
+        from ..sequence.ring_attention import ring_attention
+
+        return ring_attention
+    raise ValueError(f"unknown attn_impl {impl!r}")
+
+
 def _attention_block(x, p, cfg: TransformerConfig, cos, sin, attn_fn: AttentionFn):
     B, S, h = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -324,16 +349,18 @@ def _remat_policy(name: str):
     return pols[name]
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array, cfg: TransformerConfig,
-            attn_fn: Optional[AttentionFn] = None,
-            moe_fn: Optional[Callable] = None) -> jax.Array:
-    """tokens (B, S) int32 → logits (B, S, V) in compute dtype.
+def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
+                   cfg: TransformerConfig,
+                   attn_fn: Optional[AttentionFn] = None,
+                   moe_fn: Optional[Callable] = None) -> jax.Array:
+    """tokens (B, S) int32 → final hidden states (B, S, H) after final norm.
 
     ``attn_fn``/``moe_fn`` are injection points for Pallas flash attention,
     Ulysses/ring sequence parallelism and expert-parallel MoE dispatch.
     """
     dt = jnp.dtype(cfg.dtype)
-    attn_fn = attn_fn or xla_attention
+    if attn_fn is None:
+        attn_fn = resolve_attention(cfg.attn_impl)
     B, S = tokens.shape
 
     x = params["embed"]["tokens"].astype(dt)[tokens]
@@ -366,7 +393,15 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: TransformerConfig,
 
     x, _ = lax.scan(body, x, params["layers"])
 
-    x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    return _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: TransformerConfig,
+            attn_fn: Optional[AttentionFn] = None,
+            moe_fn: Optional[Callable] = None) -> jax.Array:
+    """tokens (B, S) int32 → logits (B, S, V) in compute dtype."""
+    dt = jnp.dtype(cfg.dtype)
+    x = forward_hidden(params, tokens, cfg, attn_fn=attn_fn, moe_fn=moe_fn)
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["tokens"].astype(dt).T
     else:
@@ -374,27 +409,42 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: TransformerConfig,
     return logits
 
 
+def shift_labels(batch: Dict[str, jax.Array]
+                 ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Next-token (labels, mask) from a batch, shifting in place (pad + mask
+    the final position) so the sequence length is unchanged — keeps S
+    divisible for sequence parallelism.  Honors explicit 'labels' and
+    'loss_mask' keys.  Shared by all loss paths (dense/tiled/pipelined)."""
+    tokens = batch["input_ids"]
+    mask = batch.get("loss_mask")
+    if "labels" in batch:
+        return batch["labels"], mask
+    labels = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
+    shift_mask = jnp.concatenate(
+        [jnp.ones_like(tokens[:, 1:]), jnp.zeros_like(tokens[:, :1])],
+        axis=1).astype(jnp.float32)
+    return labels, (shift_mask if mask is None else mask * shift_mask)
+
+
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array], cfg: TransformerConfig,
             attn_fn: Optional[AttentionFn] = None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Causal-LM cross entropy. batch: {'input_ids': (B,S)}; optional
     'labels' (shift done here when absent), optional 'loss_mask'."""
     tokens = batch["input_ids"]
-    if "labels" in batch:
-        labels = batch["labels"]
-        logits = forward(params, tokens, cfg, attn_fn=attn_fn)
-    else:
-        labels = tokens[:, 1:]
-        logits = forward(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
+    labels, mask = shift_labels(batch)
+    logits = forward(params, tokens, cfg, attn_fn=attn_fn)
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    mask = batch.get("loss_mask")
+    correct = (logits.argmax(-1) == labels).astype(jnp.float32)
     if mask is None:
         loss = nll.mean()
-        denom = nll.size
+        denom = float(nll.size)
+        acc = correct.mean()
     else:
         mask = mask.astype(jnp.float32)
         denom = jnp.maximum(mask.sum(), 1.0)
         loss = (nll * mask).sum() / denom
-    acc = (logits.argmax(-1) == labels).astype(jnp.float32).mean()
+        acc = (correct * mask).sum() / denom
     return loss, {"loss": loss, "accuracy": acc, "tokens": jnp.asarray(denom, jnp.float32)}

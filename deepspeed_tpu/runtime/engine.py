@@ -182,9 +182,14 @@ class TrainingEngine:
         )
 
     def _init_state(self) -> EngineState:
-        params = jax.tree.map(
-            lambda x, s: jax.device_put(jnp.asarray(x), s),
-            self.model.params, self.param_shardings)
+        # The train step donates state buffers, so the engine must own fresh
+        # copies — aliasing the caller's arrays would let donation delete them
+        # out from under the user (or a second engine sharing the ModelSpec).
+        # A jitted copy guarantees new buffers (device_put may alias even with
+        # may_alias=False when the sharding already matches).
+        params = jax.jit(
+            lambda t: jax.tree.map(jnp.copy, t),
+            out_shardings=self.param_shardings)(self.model.params)
         opt_shardings = self._opt_state_shardings(params)
         self.opt_shardings = opt_shardings
         opt_state = jax.jit(self.optimizer.init,
@@ -349,14 +354,25 @@ class TrainingEngine:
         gas = self.batch_config.gradient_accumulation_steps
         tb = self.batch_config.train_batch_size
 
+        sp = self.topo.size("sp")
+
         def place(x):
             x = np.asarray(x)
             if x.shape[0] != tb:
                 raise ConfigError(
                     f"batch leading dim {x.shape[0]} != train_batch_size {tb}")
             x = x.reshape((gas, tb // gas) + x.shape[1:])
-            sharding = NamedSharding(self.topo.mesh,
-                                     P(None, ("dp", "fsdp")))
+            # (gas, batch, seq, ...): batch over dp/fsdp; seq over sp when
+            # sequence parallelism is on (reference: UlyssesSPDataLoaderAdapter
+            # shards dataloader batches on the sequence dim)
+            spec = [None, ("dp", "fsdp")]
+            if sp > 1 and x.ndim >= 3:
+                if x.shape[2] % sp != 0:
+                    raise ConfigError(
+                        f"sequence length {x.shape[2]} not divisible by "
+                        f"sequence_parallel_size {sp}")
+                spec.append("sp")
+            sharding = NamedSharding(self.topo.mesh, P(*spec))
             return jax.device_put(x, sharding)
 
         return jax.tree.map(place, batch)

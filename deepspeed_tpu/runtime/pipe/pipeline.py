@@ -1,0 +1,178 @@
+"""Pipeline parallelism.
+
+Capability analogue of the reference's ``runtime/pipe/``
+(``PipelineModule`` module.py:86, 1F1B ``TrainSchedule`` schedule.py:189,
+p2p send/recv, ``PipelineEngine.train_batch`` engine.py:337).  TPU-native
+design: no instruction interpreter and no p2p processes — the pipeline is a
+single SPMD program over the ``pp`` mesh axis:
+
+* the stacked layer parameters (L, ...) are sharded over ``pp`` on the layers
+  axis — that IS the uniform ``partition_method`` of ``PipelineModule``;
+* inside ``shard_map``, a ``lax.scan`` over M + P - 1 ticks runs each stage's
+  local layers and hands activations to the next stage with ``ppermute``
+  (the SendActivation/RecvActivation instructions, on ICI);
+* backward is jax autodiff through the scan: the reversed ppermutes are the
+  SendGrad/RecvGrad instructions — a GPipe schedule with bubble
+  2(P-1)/(M+P-1); embeddings/logits stay outside the pipelined region (they
+  live on every rank, the analogue of TiedLayerSpec replication).
+
+``schedule='1f1b'`` currently lowers to this GPipe dataflow (XLA's scheduler
+overlaps the ppermute with stage compute; an explicit interleaved 1F1B is
+tracked for a later round).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ...parallel.topology import MeshTopology, get_topology
+
+
+def _stage_fn(layer_params, x, cfg, attn_fn, cos, sin):
+    """Run this stage's local slice of the layer stack (scan over L/P layers)."""
+    from ...models import transformer as tfm
+
+    def body(h, lp):
+        a_in = tfm._norm(h, lp["ln1"], cfg.norm, cfg.norm_eps)
+        h = h + tfm._attention_block(a_in, lp["attn"], cfg, cos, sin, attn_fn)
+        m_in = tfm._norm(h, lp["ln2"], cfg.norm, cfg.norm_eps)
+        if cfg.num_experts > 0:
+            from ...moe.layer import dense_moe_block
+
+            h = h + dense_moe_block(m_in, lp["moe"], cfg)
+        else:
+            h = h + tfm._mlp_block(m_in, lp["mlp"], cfg)
+        return h, None
+
+    policy = tfm._remat_policy(cfg.remat_policy)
+    if policy is not None:
+        body = jax.checkpoint(body, policy=policy, prevent_cse=False)
+    x, _ = lax.scan(body, x, layer_params)
+    return x
+
+
+def pipeline_apply(layer_params: Dict[str, Any], x: jax.Array, cfg,
+                   num_microbatches: int,
+                   attn_fn=None, topo: Optional[MeshTopology] = None
+                   ) -> jax.Array:
+    """Apply the pipelined layer stack to ``x`` (B, S, H).
+
+    B must be divisible by num_microbatches; the layers axis of every leaf in
+    ``layer_params`` must be divisible by the pp size.
+    """
+    from ...models import transformer as tfm
+
+    topo = topo or get_topology()
+    pp = topo.size("pp")
+    if pp == 1:
+        cos, sin = (None, None)
+        if cfg.position == "rope":
+            cos, sin = tfm.rope_table(x.shape[1], cfg.head_dim, cfg.rope_theta)
+        return _stage_fn(layer_params, x, cfg, attn_fn, cos, sin)
+
+    B, S, H = x.shape
+    M = num_microbatches
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by num_microbatches {M}")
+    mb = B // M
+    if cfg.attn_impl in ("ulysses", "ring") and attn_fn is None:
+        # distributed attention binds the 'sp' axis with its own shard_map,
+        # which cannot nest inside the pipeline's shard_map; within a stage
+        # the sequence is full anyway (x enters the pipeline unsharded on sp)
+        raise ValueError(
+            "attn_impl='ulysses'/'ring' cannot run inside the pipelined "
+            "stack; use 'flash' or 'xla' — each stage sees the full sequence")
+    if attn_fn is None:
+        attn_fn = tfm.resolve_attention(cfg.attn_impl)
+
+    cos, sin = (None, None)
+    if cfg.position == "rope":
+        cos, sin = tfm.rope_table(S, cfg.head_dim, cfg.rope_theta)
+
+    def local(layer_params, x):
+        me = lax.axis_index("pp")
+        n = lax.axis_size("pp")
+        # per-device shapes: batch/seq may be dp/sp-sharded
+        b_l, s_l, h_l = x.shape
+        mb_l = b_l // M
+        xm = x.reshape(M, mb_l, s_l, h_l)
+        fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 injects microbatch t (zeros once the batch is drained)
+            mb_idx = jnp.minimum(t, M - 1)
+            fresh = jnp.where(t < M, 1.0, 0.0).astype(x.dtype)
+            inject = lax.dynamic_index_in_dim(xm, mb_idx, 0, keepdims=False)
+            inp = jnp.where(me == 0, inject * fresh, state)
+            y = _stage_fn(layer_params, inp, cfg, attn_fn, cos, sin)
+            # last stage collects finished microbatch (valid when t >= n-1)
+            out_idx = jnp.clip(t - (n - 1), 0, M - 1)
+            take = (t >= n - 1) & (t - (n - 1) < M)
+            cur = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+            upd = jnp.where(take & (me == n - 1), y, cur)
+            outputs = lax.dynamic_update_index_in_dim(outputs, upd, out_idx, 0)
+            state = lax.ppermute(y, "pp", fwd_perm)
+            return (state, outputs), None
+
+        state0 = jnp.zeros((mb_l, s_l, h_l), x.dtype)
+        out0 = jnp.zeros((M, mb_l, s_l, h_l), x.dtype)
+        (_, outputs), _ = lax.scan(tick, (state0, out0),
+                                   jnp.arange(M + n - 1))
+        # hand the collected result from the last stage to every pp rank
+        outputs = lax.psum(jnp.where(me == n - 1, outputs, 0.0), "pp")
+        return outputs.reshape(b_l, s_l, h_l)
+
+    # activations enter the pipeline with the sequence axis UNsharded: the
+    # stage attention is computed over the full sequence (sp-sharded inputs
+    # are gathered here by GSPMD; see the ulysses/ring guard above)
+    batch_axes = ("dp", "fsdp")
+    x_spec = P(batch_axes, None, None)
+    # layers axis of every param leaf sharded over pp
+    param_spec = jax.tree.map(lambda _: P("pp"), layer_params)
+    return shard_map(local, mesh=topo.mesh,
+                     in_specs=(param_spec, x_spec), out_specs=x_spec,
+                     check_vma=False)(layer_params, x)
+
+
+def pipeline_loss_fn(params, batch, cfg, num_microbatches: int = 2,
+                     attn_fn=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Drop-in loss_fn running the layer stack through the pipeline.
+    Reference surface: ``PipelineEngine.train_batch`` semantics (loss averaged
+    over microbatches) but differentiable as one program."""
+    from ...models import transformer as tfm
+
+    dt = jnp.dtype(cfg.dtype)
+    tokens = batch["input_ids"]
+    B, S = tokens.shape
+
+    x = params["embed"]["tokens"].astype(dt)[tokens]
+    if cfg.position == "learned":
+        x = x + params["embed"]["position"].astype(dt)[None, :S]
+
+    x = pipeline_apply(params["layers"], x, cfg, num_microbatches,
+                       attn_fn=attn_fn)
+
+    x = tfm._norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["tokens"].astype(dt).T
+    else:
+        logits = x @ params["lm_head"]["w"].astype(dt)
+
+    labels, mask = tfm.shift_labels(batch)
+    if mask is None:
+        mask = jnp.ones_like(labels, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    acc = (((logits.argmax(-1) == labels).astype(jnp.float32)) * mask).sum() / denom
+    return loss, {"loss": loss, "accuracy": acc, "tokens": denom}

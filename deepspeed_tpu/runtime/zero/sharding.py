@@ -85,8 +85,9 @@ def default_rules(stage: int, topo: MeshTopology, shard_axis: str = "embed") -> 
         "qkv": None,
         "embed": None,
         "kv": None,
-        # stacks / experts
-        "layers": None,
+        # stacks / experts — the layers axis shards over pp (uniform
+        # PipelineModule partition); a no-op when pp == 1
+        "layers": ("pp",),
         "expert": ("ep",),
     }
     if stage >= 3:

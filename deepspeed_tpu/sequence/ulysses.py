@@ -1,0 +1,74 @@
+"""Ulysses sequence parallelism (all-to-all head↔sequence re-partition).
+
+Capability analogue of the reference's DeepSpeed-Ulysses
+(``deepspeed/sequence/layer.py`` — ``single_all_to_all:241``,
+``_SeqAllToAll:297``, ``DistributedAttention:351``): activations arrive
+sharded on the *sequence* axis; an all-to-all over the ``sp`` mesh axis
+re-shards them on the *heads* axis so each device computes full-sequence
+attention for a subset of heads, then a second all-to-all restores sequence
+sharding.  Communication volume per device is O(S·h/P) per tensor — the
+property that lets Ulysses hit >1M-token contexts.
+
+TPU-native: expressed with ``shard_map`` + ``lax.all_to_all`` lowered onto the
+ICI torus; the inner attention is the Pallas flash kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import get_topology
+
+
+def _inner_attention(q, k, v, causal):
+    from ..ops.pallas.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=causal)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      causal: bool = True,
+                      attn_fn=None) -> jax.Array:
+    """Drop-in AttentionFn. q: (B, S, H, D) with S sharded over mesh 'sp'.
+
+    Requires H % sp == 0.  GQA kv with fewer heads than sp are expanded to
+    query heads first (the reference handles uneven heads in python,
+    ``sequence/layer.py:131``; static shapes demand the repeat here).
+    """
+    topo = get_topology()
+    sp = topo.size("sp")
+    if sp == 1:
+        return _inner_attention(q, k, v, causal) if attn_fn is None \
+            else attn_fn(q, k, v, causal=causal)
+
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    if H % sp != 0:
+        raise ValueError(f"ulysses requires heads({H}) % sp({sp}) == 0")
+    if KV % sp != 0:
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    inner = attn_fn or _inner_attention
+    batch_spec = ("dp", "fsdp")
+
+    def local(q, k, v):
+        # local: (B_l, S/sp, H, D) → a2a → (B_l, S, H/sp, D)
+        q = jax.lax.all_to_all(q, "sp", split_axis=2, concat_axis=1, tiled=True)
+        k = jax.lax.all_to_all(k, "sp", split_axis=2, concat_axis=1, tiled=True)
+        v = jax.lax.all_to_all(v, "sp", split_axis=2, concat_axis=1, tiled=True)
+        o = inner(q, k, v, causal=causal)
+        # back: heads gathered, sequence re-sharded
+        return jax.lax.all_to_all(o, "sp", split_axis=1, concat_axis=2, tiled=True)
+
+    spec = P(batch_spec, "sp", None, None)
+    return shard_map(local, mesh=topo.mesh,
+                     in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

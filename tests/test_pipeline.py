@@ -1,0 +1,76 @@
+"""Pipeline-parallelism tests (reference: tests/unit/pipe/ — convergence and
+equivalence against the non-pipelined model)."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import transformer as tfm
+from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+from deepspeed_tpu.runtime.config import MeshConfig
+from deepspeed_tpu.runtime.engine import ModelSpec
+from deepspeed_tpu.runtime.pipe.pipeline import pipeline_loss_fn
+from tests.simple_model import copy_task_batch
+
+
+def _spec(cfg, num_microbatches, seed=0):
+    params = tfm.init_params(jax.random.PRNGKey(seed), cfg)
+    return ModelSpec(
+        loss_fn=lambda p, b, r: pipeline_loss_fn(p, b, cfg, num_microbatches),
+        params=params, param_axes=tfm.param_axes(cfg))
+
+
+def test_pipeline_matches_dense_forward(devices):
+    """pp=4 pipelined loss == plain scanned loss on identical params."""
+    cfg = tfm.get_config("tiny", num_layers=4, dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(8, 16)).astype(np.int32)}
+
+    topo = MeshTopology.from_config(
+        MeshConfig(pipeline_parallel_size=4, data_parallel_size=2))
+    set_topology(topo)
+    loss_pp, m_pp = jax.jit(
+        lambda p, b: pipeline_loss_fn(p, b, cfg, num_microbatches=4))(params, batch)
+    loss_ref, m_ref = tfm.loss_fn(params, batch, cfg)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    np.testing.assert_allclose(float(m_pp["accuracy"]), float(m_ref["accuracy"]),
+                               rtol=1e-5)
+
+
+def test_pipeline_gradients_match(devices):
+    cfg = tfm.get_config("tiny", num_layers=4, dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    batch = {"input_ids": np.random.default_rng(1).integers(
+        0, cfg.vocab_size, size=(4, 16)).astype(np.int32)}
+    topo = MeshTopology.from_config(
+        MeshConfig(pipeline_parallel_size=4, data_parallel_size=2))
+    set_topology(topo)
+    g_pp = jax.jit(jax.grad(
+        lambda p: pipeline_loss_fn(p, batch, cfg, num_microbatches=2)[0]))(params)
+    g_ref = jax.grad(lambda p: tfm.loss_fn(p, batch, cfg)[0])(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4), g_pp, g_ref)
+
+
+def test_pipeline_training_end_to_end(devices):
+    """pp=2 × dp=4 full engine training (reference: pipe convergence tests)."""
+    cfg = tfm.get_config("tiny", num_layers=4)
+    spec = _spec(cfg, num_microbatches=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config={
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"pipeline_parallel_size": 2, "data_parallel_size": 4},
+        "steps_per_print": 100,
+    })
+    # layer stack actually sharded over pp
+    w = engine.state.params["layers"]["mlp"]["w_in"]
+    assert not w.sharding.is_fully_replicated
+    assert w.addressable_shards[0].data.shape[0] == cfg.num_layers // 2
+
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    losses = [engine.train_batch(batch)["loss"] for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.8, losses

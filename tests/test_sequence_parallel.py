@@ -1,0 +1,92 @@
+"""Sequence-parallelism tests (reference: tests/unit/sequence_parallelism/
+test_ulysses.py — equivalence against the single-device attention)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import xla_attention
+from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+from deepspeed_tpu.runtime.config import MeshConfig
+from deepspeed_tpu.sequence.ring_attention import ring_attention
+from deepspeed_tpu.sequence.ulysses import ulysses_attention
+from tests.simple_model import copy_task_batch, tiny_lm_spec
+
+
+@pytest.fixture
+def sp_topo(devices):
+    topo = MeshTopology.from_config(
+        MeshConfig(sequence_parallel_size=8, data_parallel_size=1))
+    set_topology(topo)
+    return topo
+
+
+def _qkv(key, B=2, S=64, H=8, D=16, KV=None):
+    KV = KV or H
+    ks = jax.random.split(key, 3)
+    return (jax.random.normal(ks[0], (B, S, H, D)),
+            jax.random.normal(ks[1], (B, S, KV, D)),
+            jax.random.normal(ks[2], (B, S, KV, D)))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(sp_topo, causal):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    # use the xla inner kernel so the comparison isolates the a2a plumbing
+    out = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, causal=causal, attn_fn=xla_attention))(q, k, v)
+    ref = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_gqa(sp_topo):
+    q, k, v = _qkv(jax.random.PRNGKey(1), KV=2)
+    out = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, causal=True, attn_fn=xla_attention))(q, k, v)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_dense(sp_topo, causal):
+    q, k, v = _qkv(jax.random.PRNGKey(2))
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, causal=causal))(q, k, v)
+    ref = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_gradients(sp_topo):
+    q, k, v = _qkv(jax.random.PRNGKey(3), B=1, S=32, H=8, D=8)
+
+    f_ring = lambda q, k, v: (ring_attention(q, k, v, causal=True) ** 2).sum()
+    f_ref = lambda q, k, v: (xla_attention(q, k, v, causal=True) ** 2).sum()
+    gr = jax.jit(jax.grad(f_ring, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(gr, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4, err_msg=f"d{n}")
+
+
+@pytest.mark.parametrize("impl", ["ulysses", "ring"])
+def test_sp_training_end_to_end(devices, impl):
+    """Full engine training with sequence parallelism — the 128K-ctx recipe
+    at toy scale (BASELINE config 'Llama-3-8B Ulysses SP')."""
+    spec = tiny_lm_spec("tiny", attn_impl=impl)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"sequence_parallel_size": 4, "data_parallel_size": 2},
+        "steps_per_print": 100,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config=cfg)
+    assert engine.topo.size("sp") == 4
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    losses = [engine.train_batch(batch)["loss"] for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.8, losses
