@@ -1,0 +1,77 @@
+"""Elastic training batch-size math.
+
+Capability analogue of the reference's ``elasticity/elasticity.py``
+(``compute_elastic_config:233``, candidate batch enumeration :27-126):
+choose a global batch size that stays valid across a *range* of device
+counts so nodes can join/leave without changing hyperparameters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+from ..runtime.config import ElasticityConfig
+from ..runtime.config_utils import ConfigError
+
+
+def get_candidate_batch_sizes(micro_batches: List[int], max_batch: int) -> List[int]:
+    """All batch sizes ≤ max_batch expressible as mbs * k (reference
+    _get_candidate_batch_sizes uses powers-of-2 & multiples strategy)."""
+    candidates = set()
+    for mbs in micro_batches:
+        b = mbs
+        while b <= max_batch:
+            candidates.add(b)
+            b += mbs
+    return sorted(candidates)
+
+
+def get_valid_device_counts(batch_size: int, micro_batches: List[int],
+                            min_devices: int, max_devices: int) -> List[int]:
+    """Device counts that evenly consume ``batch_size`` with some micro batch
+    (gas = batch / (mbs * n) must be a positive integer)."""
+    valid = []
+    for n in range(min_devices, max_devices + 1):
+        if any(batch_size % (mbs * n) == 0 for mbs in micro_batches):
+            valid.append(n)
+    return valid
+
+
+def compute_elastic_config(cfg: ElasticityConfig
+                           ) -> Tuple[int, List[int], Dict[int, int]]:
+    """→ (final_batch_size, valid_device_counts, micro_batch per count).
+
+    Picks the candidate batch with the most valid device counts (ties → the
+    larger batch when ``prefer_larger_batch``). Reference:
+    ``compute_elastic_config`` elasticity.py:233.
+    """
+    if not cfg.micro_batch_sizes:
+        raise ConfigError("elasticity.micro_batch_sizes must be non-empty")
+    if cfg.min_device_count > cfg.max_device_count:
+        raise ConfigError("elasticity.min_device_count > max_device_count")
+
+    best: Tuple[int, int] = (0, 0)  # (num_valid, batch)
+    best_valid: List[int] = []
+    for batch in get_candidate_batch_sizes(cfg.micro_batch_sizes,
+                                           cfg.max_train_batch_size):
+        valid = get_valid_device_counts(batch, cfg.micro_batch_sizes,
+                                        cfg.min_device_count,
+                                        cfg.max_device_count)
+        key = (len(valid), batch if cfg.prefer_larger_batch else -batch)
+        if key > best:
+            best = key
+            best_valid = valid
+            final_batch = batch
+    if not best_valid:
+        raise ConfigError(
+            f"no batch size ≤ {cfg.max_train_batch_size} works for device "
+            f"counts [{cfg.min_device_count}, {cfg.max_device_count}] with "
+            f"micro batches {cfg.micro_batch_sizes}")
+
+    micro_per_count: Dict[int, int] = {}
+    for n in best_valid:
+        # largest micro batch that divides evenly (fewest accumulation steps)
+        micro_per_count[n] = max(m for m in cfg.micro_batch_sizes
+                                 if final_batch % (m * n) == 0)
+    return final_batch, best_valid, micro_per_count

@@ -1,0 +1,155 @@
+"""Python surface of the C++ async-IO library.
+
+Capability analogue of the reference's ``deepspeed/ops/aio`` +
+``deepspeed/nvme/ds_aio_handle.py`` (``aio_handle``): asynchronous
+tensor↔NVMe reads/writes with a thread pool and O_DIRECT.  The shared
+library ``csrc/aio/ds_aio.cpp`` is built on demand with g++ (the op-builder
+JIT role, reference ``op_builder/builder.py:545 jit_load``) and bound via
+ctypes — no pybind11 dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "aio", "ds_aio.cpp")
+_CACHE_DIR = os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu", "ops")
+
+
+def _build_library() -> str:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    so_path = os.path.join(_CACHE_DIR, "libds_aio.so")
+    src = os.path.abspath(_SRC)
+    if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(src):
+        return so_path
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           src, "-o", so_path]
+    logger.info(f"building AIO library: {' '.join(cmd)}")
+    subprocess.run(cmd, check=True, capture_output=True)
+    return so_path
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            lib = ctypes.CDLL(_build_library())
+            lib.aio_handle_new.restype = ctypes.c_void_p
+            lib.aio_handle_new.argtypes = [ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+            lib.aio_handle_free.argtypes = [ctypes.c_void_p]
+            for name in ("aio_pread", "aio_sync_pread"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                               ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+            for name in ("aio_pwrite", "aio_sync_pwrite"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                               ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+            lib.aio_wait.restype = ctypes.c_int64
+            lib.aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.aio_wait_all.restype = ctypes.c_int64
+            lib.aio_wait_all.argtypes = [ctypes.c_void_p]
+            lib.aio_alloc_aligned.restype = ctypes.c_void_p
+            lib.aio_alloc_aligned.argtypes = [ctypes.c_int64, ctypes.c_int64]
+            lib.aio_free_aligned.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+    return _LIB
+
+
+class AsyncIOHandle:
+    """Reference: ``aio_handle`` (csrc/aio/py_lib/deepspeed_py_io_handle.cpp).
+
+    Numpy-array based: jax host arrays expose buffers via numpy without copies.
+    """
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
+                 thread_count: int = 1, use_direct: bool = False):
+        self._lib = _lib()
+        self._h = self._lib.aio_handle_new(block_size, queue_depth, thread_count)
+        self.use_direct = use_direct
+        self.block_size = block_size
+        self.thread_count = thread_count
+        # keep buffers of in-flight requests alive
+        self._pinned: dict[int, np.ndarray] = {}
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.aio_handle_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    # -- async ---------------------------------------------------------
+    def pread(self, path: str, buffer: np.ndarray, file_offset: int = 0) -> int:
+        assert buffer.flags["C_CONTIGUOUS"]
+        req = self._lib.aio_pread(self._h, path.encode(),
+                                  buffer.ctypes.data_as(ctypes.c_void_p),
+                                  buffer.nbytes, file_offset,
+                                  1 if self.use_direct else 0)
+        self._pinned[req] = buffer
+        return req
+
+    def pwrite(self, path: str, buffer: np.ndarray, file_offset: int = 0) -> int:
+        assert buffer.flags["C_CONTIGUOUS"]
+        req = self._lib.aio_pwrite(self._h, path.encode(),
+                                   buffer.ctypes.data_as(ctypes.c_void_p),
+                                   buffer.nbytes, file_offset,
+                                   1 if self.use_direct else 0)
+        self._pinned[req] = buffer
+        return req
+
+    def wait(self, request_id: int) -> int:
+        rc = self._lib.aio_wait(self._h, request_id)
+        self._pinned.pop(request_id, None)
+        if rc < 0:
+            raise OSError(-rc, f"aio request {request_id} failed: {os.strerror(-rc)}")
+        return rc
+
+    def wait_all(self) -> int:
+        rc = self._lib.aio_wait_all(self._h)
+        self._pinned.clear()
+        if rc < 0:
+            raise OSError(-rc, f"aio wait_all failed: {os.strerror(-rc)}")
+        return rc
+
+    # -- sync convenience ---------------------------------------------
+    def sync_pread(self, path: str, buffer: np.ndarray, file_offset: int = 0) -> int:
+        rc = self._lib.aio_sync_pread(self._h, path.encode(),
+                                      buffer.ctypes.data_as(ctypes.c_void_p),
+                                      buffer.nbytes, file_offset,
+                                      1 if self.use_direct else 0)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return rc
+
+    def sync_pwrite(self, path: str, buffer: np.ndarray, file_offset: int = 0) -> int:
+        rc = self._lib.aio_sync_pwrite(self._h, path.encode(),
+                                       buffer.ctypes.data_as(ctypes.c_void_p),
+                                       buffer.nbytes, file_offset,
+                                       1 if self.use_direct else 0)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return rc
+
+
+def aio_available() -> bool:
+    try:
+        _lib()
+        return True
+    except Exception as e:  # pragma: no cover
+        logger.warning(f"AIO library unavailable: {e}")
+        return False

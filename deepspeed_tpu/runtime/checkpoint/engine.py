@@ -99,7 +99,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     # state's device buffers, so the device_get must happen before this
     # function returns, never inside the background thread.
     host_params = jax.device_get(state.params)
-    host_opt = jax.device_get(state.opt_state)
+    if getattr(engine, "offloaded_optimizer", None) is not None:
+        host_opt = jax.device_get(
+            engine.offloaded_optimizer.state_for_checkpoint())
+    else:
+        host_opt = jax.device_get(state.opt_state)
     meta = {
         "step": int(state.step),
         "skipped_steps": int(state.skipped_steps),
@@ -191,7 +195,24 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     params = jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s.sharding),
                           params, engine.state.params)
 
-    if load_optimizer_states:
+    if getattr(engine, "offloaded_optimizer", None) is not None:
+        # rebuild the fp32 master from the loaded params — otherwise the next
+        # step would overwrite them with updates from the stale master
+        engine.offloaded_optimizer.reset_master(params)
+        if load_optimizer_states:
+            flat_opt = _load_tree_flat(
+                os.path.join(ckpt_dir, "optimizer.safetensors"))
+            template = engine.offloaded_optimizer.state_for_checkpoint()
+            try:
+                loaded = _unflatten_like(template, flat_opt)
+            except KeyError as e:
+                raise ValueError(
+                    f"optimizer state in {ckpt_dir} does not match the "
+                    f"engine's optimizer structure ({e}); if the optimizer "
+                    "config changed, pass load_optimizer_states=False") from e
+            engine.offloaded_optimizer.load_state(loaded)
+        opt_state = engine.state.opt_state
+    elif load_optimizer_states:
         flat_opt = _load_tree_flat(os.path.join(ckpt_dir, "optimizer.safetensors"))
         try:
             opt_state = _unflatten_like(engine.state.opt_state, flat_opt)

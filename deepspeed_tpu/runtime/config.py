@@ -92,6 +92,10 @@ class OffloadParamConfig(DSConfigModel):
     """Reference: ``runtime/zero/offload_config.py`` DeepSpeedZeroOffloadParamConfig."""
 
     device: OffloadDeviceEnum = OffloadDeviceEnum.none
+
+    @property
+    def device_str(self) -> str:
+        return self.device.value
     nvme_path: Optional[str] = None
     buffer_count: int = 5
     buffer_size: int = 100_000_000
@@ -101,6 +105,10 @@ class OffloadParamConfig(DSConfigModel):
 
 class OffloadOptimizerConfig(DSConfigModel):
     device: OffloadDeviceEnum = OffloadDeviceEnum.none
+
+    @property
+    def device_str(self) -> str:
+        return self.device.value
     nvme_path: Optional[str] = None
     buffer_count: int = 4
     pin_memory: bool = True

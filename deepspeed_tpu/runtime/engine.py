@@ -137,12 +137,27 @@ class TrainingEngine:
         chain.append(create_optimizer(config.optimizer, self.lr_schedule, wd_mask))
         self.optimizer = optax.chain(*chain)
 
+        # ---- offload mode --------------------------------------------
+        off = config.zero_optimization.offload_optimizer
+        self.offload_enabled = off is not None and off.device_str != "none"
+        self.offloaded_optimizer = None
+        if self.offload_enabled and self.fp16_enabled:
+            raise ConfigError(
+                "fp16 + offload_optimizer is not supported; use bf16")
+
         # ---- state init (sharded at construction) ---------------------
         self.opt_shardings = None  # set inside _init_state
         self.state = self._init_state()
 
         # ---- step function -------------------------------------------
-        self._train_step = self._build_train_step()
+        if self.offload_enabled:
+            from .zero.offload import OffloadedOptimizer
+
+            self.offloaded_optimizer = OffloadedOptimizer(
+                self.optimizer, self.state.params, off, aio=config.aio)
+            self._grad_step = self._build_grad_step()
+        else:
+            self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
 
         # ---- observability -------------------------------------------
@@ -190,10 +205,16 @@ class TrainingEngine:
         params = jax.jit(
             lambda t: jax.tree.map(jnp.copy, t),
             out_shardings=self.param_shardings)(self.model.params)
-        opt_shardings = self._opt_state_shardings(params)
-        self.opt_shardings = opt_shardings
-        opt_state = jax.jit(self.optimizer.init,
-                            out_shardings=opt_shardings)(params)
+        if self.offload_enabled:
+            # optimizer state lives on host (OffloadedOptimizer); keep no
+            # device copy at all — that's the memory savings offload buys
+            self.opt_shardings = ()
+            opt_state = ()
+        else:
+            opt_shardings = self._opt_state_shardings(params)
+            self.opt_shardings = opt_shardings
+            opt_state = jax.jit(self.optimizer.init,
+                                out_shardings=opt_shardings)(params)
         if self.fp16_enabled:
             ls = init_loss_scale(
                 initial_scale_power=self.config.fp16.initial_scale_power,
@@ -335,6 +356,60 @@ class TrainingEngine:
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
+    def _build_grad_step(self):
+        """Device half of the offloaded step: fwd+bwd+accumulate only.
+        (Reference: ZeRO-Offload computes grads on GPU, optimizer on CPU.)"""
+        gas = self.batch_config.gradient_accumulation_steps
+        loss_fn = self.model.loss_fn
+
+        def step_fn(params, batch, rng):
+            rng, step_rng = jax.random.split(rng)
+
+            def accum(carry, mb):
+                grads_acc, metrics_acc = carry
+                (_, metrics), grads = jax.value_and_grad(
+                    lambda p: loss_fn(p, mb, step_rng), has_aux=True)(params)
+                grads = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                     grads_acc, grads)
+                metrics_acc = jax.tree.map(lambda a, m: a + m.astype(jnp.float32),
+                                           metrics_acc, metrics)
+                return (grads, metrics_acc), None
+
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            one_mb = jax.tree.map(lambda x: x[0], batch)
+            _, metrics_shape = jax.eval_shape(
+                lambda p, b: loss_fn(p, b, step_rng), params, one_mb)
+            zero_metrics = jax.tree.map(lambda s: jnp.zeros((), jnp.float32),
+                                        metrics_shape)
+            if gas > 1:
+                (grads, msum), _ = jax.lax.scan(accum, (zero_grads, zero_metrics),
+                                                batch)
+            else:
+                (grads, msum), _ = accum((zero_grads, zero_metrics), one_mb)
+            metrics = jax.tree.map(lambda m: m / gas, msum)
+            grads = jax.tree.map(lambda g: g / float(gas), grads)
+            metrics = dict(metrics)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return grads, metrics, rng
+
+        return jax.jit(step_fn)
+
+    def _train_batch_offloaded(self, placed) -> Dict[str, float]:
+        lr = self.get_lr()  # pre-increment: the lr this update applies
+        grads, metrics, rng = self._grad_step(self.state.params, placed,
+                                              self.state.rng)
+        new_params = self.offloaded_optimizer.step(grads)
+        new_params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), new_params, self.param_shardings)
+        self.state = EngineState(
+            step=self.state.step + 1, params=new_params,
+            opt_state=self.state.opt_state, loss_scale=self.state.loss_scale,
+            rng=rng, skipped_steps=self.state.skipped_steps)
+        out = {k: float(v) for k, v in metrics.items()}
+        out["lr"] = lr
+        return out
+
     def _build_eval_step(self):
         loss_fn = self.model.eval_fn or self.model.loss_fn
 
@@ -386,9 +461,12 @@ class TrainingEngine:
         ``PipelineEngine.train_batch`` / engine forward+backward+step."""
         self.tput.start()
         placed = self._place_batch(batch)
-        self.state, metrics = self._train_step(self.state, placed)
+        if self.offload_enabled:
+            out = self._train_batch_offloaded(placed)
+        else:
+            self.state, metrics = self._train_step(self.state, placed)
+            out = {k: float(v) for k, v in metrics.items()}
         self.global_steps += 1
-        out = {k: float(v) for k, v in metrics.items()}
         self.tput.stop()
         self._write_monitor(out)
         if self.config.steps_per_print and \

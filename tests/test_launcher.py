@@ -1,0 +1,95 @@
+"""Launcher + elasticity tests (reference: tests/unit/launcher/,
+tests/unit/elasticity/)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
+                                                 get_valid_device_counts)
+from deepspeed_tpu.launcher.runner import (decode_world_info, encode_world_info,
+                                           filter_hosts, parse_args,
+                                           parse_hostfile)
+from deepspeed_tpu.runtime.config import ElasticityConfig
+from deepspeed_tpu.runtime.config_utils import ConfigError
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("""
+# tpu pod hosts
+worker-0 slots=4
+worker-1 slots=4
+worker-2   # defaults to 1 slot
+""")
+    hosts = parse_hostfile(str(hf))
+    assert hosts == {"worker-0": 4, "worker-1": 4, "worker-2": 1}
+
+
+def test_parse_hostfile_duplicate(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("a slots=1\na slots=2\n")
+    with pytest.raises(ValueError):
+        parse_hostfile(str(hf))
+
+
+def test_filter_hosts():
+    hosts = {"a": 1, "b": 1, "c": 1}
+    assert list(filter_hosts(hosts, include="a,b")) == ["a", "b"]
+    assert list(filter_hosts(hosts, exclude="b")) == ["a", "c"]
+    with pytest.raises(ValueError):
+        filter_hosts(hosts, include="zzz")
+    with pytest.raises(ValueError):
+        filter_hosts(hosts, exclude="a,b,c")
+
+
+def test_world_info_roundtrip():
+    hosts = {"w0": 4, "w1": 4}
+    assert decode_world_info(encode_world_info(hosts)) == hosts
+
+
+def test_args_parse_remainder():
+    args = parse_args(["--hosts", "localhost", "train.py", "--lr", "1e-4"])
+    assert args.script == "train.py"
+    assert args.script_args == ["--lr", "1e-4"]
+
+
+def test_local_launch_runs_script(tmp_path):
+    script = tmp_path / "hello.py"
+    script.write_text("import os, sys; sys.exit(0 if os.environ.get('FOO')=='bar' else 3)")
+    from deepspeed_tpu.launcher import runner
+
+    rc = runner.main(["--hosts", "localhost", "--env", "FOO=bar", str(script)])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# elasticity
+# ---------------------------------------------------------------------------
+
+
+def test_valid_device_counts():
+    # batch 24, micro batches {2,3}: n valid iff 24 % (2n)==0 or 24 % (3n)==0
+    valid = get_valid_device_counts(24, [2, 3], 1, 12)
+    assert 4 in valid and 12 in valid
+    assert 5 not in valid
+
+
+def test_compute_elastic_config():
+    cfg = ElasticityConfig(enabled=True, max_train_batch_size=64,
+                           micro_batch_sizes=[2, 4], min_device_count=1,
+                           max_device_count=8)
+    batch, valid, micro = compute_elastic_config(cfg)
+    assert batch == 48  # maximizes coverage: valid for 6 of 8 device counts
+    assert valid == [1, 2, 3, 4, 6, 8]
+    for n, m in micro.items():
+        assert batch % (m * n) == 0
+
+
+def test_elastic_config_impossible():
+    cfg = ElasticityConfig(enabled=True, max_train_batch_size=3,
+                           micro_batch_sizes=[5], min_device_count=1,
+                           max_device_count=2)
+    with pytest.raises(ConfigError):
+        compute_elastic_config(cfg)
