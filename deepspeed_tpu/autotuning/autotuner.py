@@ -1,0 +1,135 @@
+"""Autotuner.
+
+Capability analogue of the reference's ``autotuning/autotuner.py``
+(``Autotuner:42``, ``tune:404`` + the experiment ``scheduler.py``): search
+over (zero stage, micro batch size, remat policy) measuring real training
+throughput and return the best config.
+
+TPU-native simplification: experiments run in-process (no launcher round
+trips) — each candidate builds an engine, times a few steps, and is torn
+down; compile cache makes repeated shapes cheap.  OOMs and invalid configs
+are recorded as failures, mirroring the reference's fault-tolerant sweep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime.config import AutotuningConfig
+from ..utils.logging import log_dist, logger
+
+
+@dataclasses.dataclass
+class Experiment:
+    config_overrides: Dict[str, Any]
+    throughput: Optional[float] = None  # samples/sec
+    step_time_s: Optional[float] = None
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None and self.throughput is not None
+
+
+DEFAULT_SPACE = {
+    "zero_stage": [0, 1, 2, 3],
+    "micro_batch": [1, 2, 4, 8],
+    "remat_policy": None,  # model-owned; engine-level space below
+}
+
+
+class Autotuner:
+    def __init__(self, cfg: AutotuningConfig,
+                 make_engine: Callable[[Dict[str, Any]], Any],
+                 make_batch: Callable[[int], Dict[str, np.ndarray]],
+                 space: Optional[Dict[str, Sequence]] = None):
+        """``make_engine(overrides)`` builds a TrainingEngine for a candidate;
+        ``make_batch(train_batch_size)`` supplies a host batch."""
+        self.cfg = cfg
+        self.make_engine = make_engine
+        self.make_batch = make_batch
+        self.space = space or {
+            "zero_stage": [0, 1, 2, 3],
+            "micro_batch": [1, 2, 4],
+        }
+        self.experiments: List[Experiment] = []
+
+    def _candidates(self) -> List[Dict[str, Any]]:
+        keys = list(self.space)
+        combos = itertools.product(*(self.space[k] for k in keys))
+        return [dict(zip(keys, c)) for c in combos]
+
+    def _measure(self, overrides: Dict[str, Any]) -> Experiment:
+        exp = Experiment(config_overrides=dict(overrides))
+        engine = None
+        try:
+            engine = self.make_engine(overrides)
+            batch = self.make_batch(engine.train_batch_size)
+            warmup = max(1, self.cfg.start_profile_step - 1)
+            steps = max(1, self.cfg.end_profile_step - self.cfg.start_profile_step)
+            for _ in range(warmup):
+                engine.train_batch(batch)
+            engine.accelerator.synchronize()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                engine.train_batch(batch)
+            engine.accelerator.synchronize()
+            dt = (time.perf_counter() - t0) / steps
+            exp.step_time_s = dt
+            exp.throughput = engine.train_batch_size / dt
+        except Exception as e:  # OOM / invalid combos are data, not crashes
+            exp.error = f"{type(e).__name__}: {e}"
+            logger.warning(f"autotune candidate {overrides} failed: {exp.error}")
+        finally:
+            del engine
+        return exp
+
+    def _run(self, overrides: Dict[str, Any]) -> Experiment:
+        exp = self._measure(overrides)
+        self.experiments.append(exp)
+        if exp.ok:
+            log_dist(f"autotune {overrides}: "
+                     f"{exp.throughput:.1f} samples/s ({exp.step_time_s * 1e3:.0f} ms)")
+        return exp
+
+    def tune(self) -> Tuple[Dict[str, Any], List[Experiment]]:
+        """Reference: ``Autotuner.tune`` — returns (best overrides, all runs).
+
+        Fast mode (two-phase, reference --fast): sweep the micro-batch axis at
+        the first value of every other axis, then sweep the remaining axes at
+        the winning micro batch."""
+        if self.cfg.fast and "micro_batch" in self.space and len(self.space) > 1:
+            others_first = {k: v[0] for k, v in self.space.items()
+                            if k != "micro_batch"}
+            phase1 = [dict(others_first, micro_batch=m)
+                      for m in self.space["micro_batch"]
+                      [: self.cfg.num_tuning_micro_batch_sizes]]
+            for ov in phase1:
+                self._run(ov)
+            ok1 = [e for e in self.experiments if e.ok]
+            best_micro = (max(ok1, key=lambda e: e.throughput)
+                          .config_overrides["micro_batch"]
+                          if ok1 else self.space["micro_batch"][0])
+            other_keys = [k for k in self.space if k != "micro_batch"]
+            for combo in itertools.product(*(self.space[k] for k in other_keys)):
+                ov = dict(zip(other_keys, combo), micro_batch=best_micro)
+                if not any(e.config_overrides == ov for e in self.experiments):
+                    self._run(ov)
+        else:
+            for overrides in self._candidates():
+                self._run(overrides)
+        ok = [e for e in self.experiments if e.ok]
+        if not ok:
+            raise RuntimeError("autotuning: every candidate failed")
+        if self.cfg.metric == "latency":
+            best = min(ok, key=lambda e: e.step_time_s)
+        else:  # throughput (default) / flops proxy
+            best = max(ok, key=lambda e: e.throughput)
+        log_dist(f"autotune best: {best.config_overrides} "
+                 f"({best.throughput:.1f} samples/s)")
+        return best.config_overrides, self.experiments

@@ -1,0 +1,164 @@
+"""Compression suite: quantization-aware training, pruning, layer reduction.
+
+Capability analogue of the reference's ``deepspeed/compression/``
+(``compress.py init_compression/redundancy_clean``, ``basic_layer.py``
+QuantAct/LinearLayer_Compress, sparse/row/head pruning, ``scheduler.py``):
+config-driven compression applied to the *param pytree + forward functions*
+instead of swapped nn.Modules.
+
+Functional design:
+* QAT — straight-through-estimator fake quantization wrapped around weights
+  (``quantize_weights_ste``) and activations (``quantize_act_ste``);
+* pruning — binary masks derived from magnitude (sparse/row/head variants)
+  held beside params and applied multiplicatively; ``redundancy_clean``
+  materializes them (true zeroing);
+* layer reduction — slicing the stacked layer axis to a subset.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# quantization-aware training (STE fake quant)
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+_ste_round.defvjp(lambda x: (jnp.round(x), None), lambda _, g: (g,))
+
+
+def fake_quantize(x: jax.Array, bits: int = 8, axis: Optional[int] = None
+                  ) -> jax.Array:
+    """Symmetric fake quant with straight-through gradients
+    (reference: QuantAct / weight quantization in basic_layer.py)."""
+    qmax = (1 << (bits - 1)) - 1
+    if axis is None:
+        scale = jnp.max(jnp.abs(x)) / qmax
+    else:
+        scale = jnp.max(jnp.abs(x), axis=axis, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(_ste_round(x / scale), -qmax - 1, qmax)
+    return q * scale
+
+
+def quantize_weights_ste(params: Any, bits: int = 8,
+                         filter_fn=None) -> Any:
+    """Apply fake quant to every (matching) weight — call inside the loss so
+    gradients flow via STE."""
+
+    def one(path, leaf):
+        if filter_fn is not None and not filter_fn(path, leaf):
+            return leaf
+        if getattr(leaf, "ndim", 0) < 2:
+            return leaf
+        return fake_quantize(leaf, bits=bits)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+# ---------------------------------------------------------------------------
+# pruning
+# ---------------------------------------------------------------------------
+
+
+def magnitude_prune_mask(w: jax.Array, sparsity: float) -> jax.Array:
+    """Unstructured (sparse) pruning mask: drop smallest |w| fraction."""
+    k = int(w.size * (1.0 - sparsity))
+    if k <= 0:
+        return jnp.zeros_like(w, dtype=bool)
+    thresh = jnp.sort(jnp.abs(w).reshape(-1))[-k]
+    return jnp.abs(w) >= thresh
+
+
+def row_prune_mask(w: jax.Array, sparsity: float) -> jax.Array:
+    """Structured row pruning (output-channel) by row L1 norm."""
+    norms = jnp.abs(w).sum(axis=0)
+    k = max(1, int(norms.size * (1.0 - sparsity)))
+    thresh = jnp.sort(norms)[-k]
+    return jnp.broadcast_to(norms >= thresh, w.shape)
+
+
+def head_prune_mask(w_o: jax.Array, num_heads: int, sparsity: float) -> jax.Array:
+    """Attention-head pruning on the OUTPUT projection w_o
+    (heads*dim, hidden): zeroing a head's w_o *rows* removes that head's
+    contribution entirely (masking q/k/v alone would still let the head's
+    value flow through as a uniform-softmax mean). Heads ranked by their
+    w_o row-group L1 norm."""
+    hd, hidden = w_o.shape
+    d = hd // num_heads
+    per_head = jnp.abs(w_o.reshape(num_heads, d, hidden)).sum(axis=(1, 2))
+    k = max(1, int(num_heads * (1.0 - sparsity)))
+    thresh = jnp.sort(per_head)[-k]
+    keep = per_head >= thresh  # (num_heads,)
+    return jnp.broadcast_to(jnp.repeat(keep, d)[:, None], w_o.shape)
+
+
+def build_pruning_masks(params: Any, config: Dict[str, Any],
+                        num_heads: Optional[int] = None) -> Any:
+    """Config-driven mask tree (reference: init_compression walking modules).
+    config keys: sparse_pruning/row_pruning/head_pruning each with
+    {enabled, dense_ratio}."""
+
+    def one(path, leaf):
+        if getattr(leaf, "ndim", 0) < 2:
+            return None
+        name = "/".join(str(getattr(p, "key", p)) for p in path).lower()
+        sp = config.get("sparse_pruning", {})
+        rp = config.get("row_pruning", {})
+        hp = config.get("head_pruning", {})
+        if hp.get("enabled") and num_heads and "wo" in name:
+            # layer-stacked wo: (L, heads*dim, hidden) → per-layer masks
+            if leaf.ndim == 3:
+                return jnp.stack([
+                    head_prune_mask(leaf[i], num_heads,
+                                    1 - hp.get("dense_ratio", 0.5))
+                    for i in range(leaf.shape[0])])
+            return head_prune_mask(leaf, num_heads, 1 - hp.get("dense_ratio", 0.5))
+        if rp.get("enabled") and ("mlp" in name or "w_in" in name or "w_out" in name):
+            return row_prune_mask(leaf, 1 - rp.get("dense_ratio", 0.5))
+        if sp.get("enabled"):
+            return magnitude_prune_mask(leaf, 1 - sp.get("dense_ratio", 0.5))
+        return None
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def apply_masks(params: Any, masks: Any) -> Any:
+    """Multiplicative application (redundancy_clean materialization)."""
+    return jax.tree.map(
+        lambda p, m: p if m is None else p * m.astype(p.dtype),
+        params, masks, is_leaf=lambda x: x is None)
+
+
+def sparsity_of(params: Any, masks: Any) -> float:
+    total = kept = 0
+    for p, m in zip(jax.tree.leaves(params),
+                    jax.tree.leaves(masks, is_leaf=lambda x: x is None)):
+        if m is None:
+            continue
+        total += m.size
+        kept += int(m.sum())
+    return 1.0 - kept / total if total else 0.0
+
+
+# ---------------------------------------------------------------------------
+# layer reduction (depth pruning / distillation prep)
+# ---------------------------------------------------------------------------
+
+
+def reduce_layers(params: Dict[str, Any], keep_layers) -> Dict[str, Any]:
+    """Slice the stacked layer axis to ``keep_layers`` (reference:
+    layer_reduction teacher→student init)."""
+    keep = jnp.asarray(keep_layers)
+    out = dict(params)
+    out["layers"] = jax.tree.map(lambda l: l[keep], params["layers"])
+    return out

@@ -1,0 +1,367 @@
+"""Continuous-batching inference engine (v2).
+
+Capability analogue of the reference's FastGen / inference-v2 engine
+(``inference/v2/engine_v2.py:30 InferenceEngineV2.put``, Dynamic SplitFuse
+scheduling ``scheduling_utils.py``, ragged forward over
+``model_implementations/``): many requests share one forward pass; decode
+tokens are batched with *chunks* of prefill so every step runs near the
+compute-optimal token budget.
+
+TPU-native: the ragged batch is padded to a static token budget (XLA static
+shapes); KV lives in a paged (num_blocks, block_size, kv_heads, head_dim)
+pool per layer, indexed through block tables; attention uses the paged
+Pallas kernel for pure-decode steps and a gather-based XLA path for mixed
+prefill steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from functools import partial
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import transformer as tfm
+from .ragged import (KVCacheManager, RaggedBatch, RaggedBatchBuilder,
+                     SequenceDescriptor)
+
+
+@dataclasses.dataclass
+class V2Config:
+    max_tokens_per_step: int = 256  # ragged token budget (SplitFuse chunk)
+    max_seqs: int = 16
+    block_size: int = 64
+    num_blocks: int = 512
+    max_blocks_per_seq: int = 32
+    dtype: str = "bfloat16"
+
+
+# ---------------------------------------------------------------------------
+# ragged forward (jitted once; static shapes from V2Config)
+# ---------------------------------------------------------------------------
+
+
+def ragged_attention_xla(q, k_cache, v_cache, block_tables, context_lens,
+                         seq_index, position_ids, cfg: tfm.TransformerConfig,
+                         block_size: int):
+    """Correct-for-everything gather path. q: (T, H, D); caches
+    (num_blocks, bs, KV, D); returns (T, H, D)."""
+    import math
+
+    T, H, D = q.shape
+    KV = k_cache.shape[2]
+    max_blocks = block_tables.shape[1]
+    S_max = max_blocks * block_size
+
+    # gather each sequence's cache: (max_seqs, S_max, KV, D)
+    k_seq = k_cache[block_tables].reshape(block_tables.shape[0], S_max, KV, D)
+    v_seq = v_cache[block_tables].reshape(block_tables.shape[0], S_max, KV, D)
+    # per-token views (T, S_max, KV, D)
+    row = jnp.clip(seq_index, 0, block_tables.shape[0] - 1)
+    k_t = k_seq[row]
+    v_t = v_seq[row]
+    if KV != H:
+        rep = H // KV
+        k_t = jnp.repeat(k_t, rep, axis=2)
+        v_t = jnp.repeat(v_t, rep, axis=2)
+    scores = jnp.einsum("thd,tshd->ths", q.astype(jnp.float32),
+                        k_t.astype(jnp.float32)) / math.sqrt(D)
+    key_pos = jnp.arange(S_max)[None, None, :]
+    valid = key_pos <= position_ids[:, None, None]  # causal within sequence
+    valid &= key_pos < context_lens[row][:, None, None]
+    valid &= (seq_index >= 0)[:, None, None]
+    scores = jnp.where(valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("ths,tshd->thd", probs, v_t.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
+    dt = jnp.dtype(v2.dtype)
+    bs = v2.block_size
+
+    def fwd(params, caches, token_ids, position_ids, seq_index, block_tables,
+            context_lens, logits_rows):
+        T = token_ids.shape[0]
+        x = params["embed"]["tokens"].astype(dt)[token_ids]  # (T, H)
+        if model_cfg.position == "learned":
+            x = x + params["embed"]["position"].astype(dt)[position_ids]
+        cos_full, sin_full = (None, None)
+        if model_cfg.position == "rope":
+            max_len = v2.max_blocks_per_seq * bs
+            cos_full, sin_full = tfm.rope_table(max_len, model_cfg.head_dim,
+                                                model_cfg.rope_theta)
+
+        # KV write positions: token t → (block_tables[seq, pos//bs], pos%bs)
+        blk_col = position_ids // bs
+        row = jnp.clip(seq_index, 0, block_tables.shape[0] - 1)
+        blk_ids = block_tables[row, blk_col]  # (T,)
+        offsets = position_ids % bs
+        write_mask = (seq_index >= 0)
+        # park invalid tokens' writes in a scratch block (last block id is
+        # reserved by the engine for this)
+        scratch_block = caches["k"].shape[1] - 1
+        blk_ids = jnp.where(write_mask, blk_ids, scratch_block)
+
+        nh, nkv, hd = model_cfg.num_heads, model_cfg.kv_heads, model_cfg.head_dim
+
+        def layer_body(x, inp):
+            lp, k_cache, v_cache = inp
+            a_in = tfm._norm(x, lp["ln1"], model_cfg.norm, model_cfg.norm_eps)
+            q = (a_in @ lp["attn"]["wq"].astype(dt)).reshape(T, nh, hd)
+            k = (a_in @ lp["attn"]["wk"].astype(dt)).reshape(T, nkv, hd)
+            v = (a_in @ lp["attn"]["wv"].astype(dt)).reshape(T, nkv, hd)
+            if model_cfg.position == "rope":
+                cos = cos_full[position_ids]
+                sin = sin_full[position_ids]
+                # apply_rope expects (B,S,H,D); use batch dim 1
+                q = tfm.apply_rope(q[None], cos, sin)[0]
+                k = tfm.apply_rope(k[None], cos, sin)[0]
+            k_cache = k_cache.at[blk_ids, offsets].set(k.astype(k_cache.dtype))
+            v_cache = v_cache.at[blk_ids, offsets].set(v.astype(v_cache.dtype))
+            o = ragged_attention_xla(q, k_cache, v_cache, block_tables,
+                                     context_lens, seq_index, position_ids,
+                                     model_cfg, bs)
+            x = x + o.reshape(T, nh * hd) @ lp["attn"]["wo"].astype(dt)
+            m_in = tfm._norm(x, lp["ln2"], model_cfg.norm, model_cfg.norm_eps)
+            if model_cfg.num_experts > 0:
+                from ...moe.layer import dense_moe_block
+
+                x = x + dense_moe_block(m_in[None], lp["moe"], model_cfg)[0]
+            else:
+                x = x + tfm._mlp_block(m_in[None], lp["mlp"], model_cfg)[0]
+            return x, (k_cache, v_cache)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            layer_body, x, (params["layers"], caches["k"], caches["v"]))
+        x = tfm._norm(x, params["final_norm"], model_cfg.norm, model_cfg.norm_eps)
+        last_hidden = x[logits_rows]  # (max_seqs, H)
+        if model_cfg.tie_embeddings:
+            logits = last_hidden @ params["embed"]["tokens"].astype(dt).T
+        else:
+            logits = last_hidden @ params["lm_head"]["w"].astype(dt)
+        return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+    return jax.jit(fwd, donate_argnums=(1,))
+
+
+def build_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
+    """Pure-decode step: one token per sequence, attention through the paged
+    Pallas kernel (ops/pallas/paged_attention.py) — the FastGen decode hot
+    loop.  tokens/positions: (max_seqs,); context_lens INCLUDE the new token.
+    """
+    from ...ops.pallas.paged_attention import paged_decode_attention
+
+    dt = jnp.dtype(v2.dtype)
+    bs = v2.block_size
+
+    def fwd(params, caches, token_ids, position_ids, block_tables, context_lens):
+        S = token_ids.shape[0]
+        x = params["embed"]["tokens"].astype(dt)[token_ids]  # (S, H)
+        if model_cfg.position == "learned":
+            x = x + params["embed"]["position"].astype(dt)[position_ids]
+        cos_full, sin_full = (None, None)
+        if model_cfg.position == "rope":
+            max_len = v2.max_blocks_per_seq * bs
+            cos_full, sin_full = tfm.rope_table(max_len, model_cfg.head_dim,
+                                                model_cfg.rope_theta)
+
+        # rows beyond the active sequences write to the scratch block
+        active = context_lens > 0
+        blk_ids = jnp.where(
+            active,
+            block_tables[jnp.arange(S), position_ids // bs],
+            caches["k"].shape[1] - 1)
+        offsets = position_ids % bs
+        nh, nkv, hd = model_cfg.num_heads, model_cfg.kv_heads, model_cfg.head_dim
+
+        def layer_body(x, inp):
+            lp, k_cache, v_cache = inp
+            a_in = tfm._norm(x, lp["ln1"], model_cfg.norm, model_cfg.norm_eps)
+            q = (a_in @ lp["attn"]["wq"].astype(dt)).reshape(S, nh, hd)
+            k = (a_in @ lp["attn"]["wk"].astype(dt)).reshape(S, nkv, hd)
+            v = (a_in @ lp["attn"]["wv"].astype(dt)).reshape(S, nkv, hd)
+            if model_cfg.position == "rope":
+                cos = cos_full[position_ids][:, None, :].astype(dt)  # (S,1,hd/2)
+                sin = sin_full[position_ids][:, None, :].astype(dt)
+                # inline rope on (S, heads, d): same pairing as apply_rope
+                def rot(t):
+                    t1, t2 = t[..., ::2], t[..., 1::2]
+                    o1 = t1 * cos - t2 * sin
+                    o2 = t2 * cos + t1 * sin
+                    return jnp.stack([o1, o2], axis=-1).reshape(t.shape)
+
+                q, k = rot(q), rot(k)
+            k_cache = k_cache.at[blk_ids, offsets].set(k.astype(k_cache.dtype))
+            v_cache = v_cache.at[blk_ids, offsets].set(v.astype(v_cache.dtype))
+            o = paged_decode_attention(q, k_cache, v_cache, block_tables,
+                                       context_lens)
+            x = x + o.reshape(S, nh * hd) @ lp["attn"]["wo"].astype(dt)
+            m_in = tfm._norm(x, lp["ln2"], model_cfg.norm, model_cfg.norm_eps)
+            if model_cfg.num_experts > 0:
+                from ...moe.layer import dense_moe_block
+
+                x = x + dense_moe_block(m_in[None], lp["moe"], model_cfg)[0]
+            else:
+                x = x + tfm._mlp_block(m_in[None], lp["mlp"], model_cfg)[0]
+            return x, (k_cache, v_cache)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            layer_body, x, (params["layers"], caches["k"], caches["v"]))
+        x = tfm._norm(x, params["final_norm"], model_cfg.norm, model_cfg.norm_eps)
+        if model_cfg.tie_embeddings:
+            logits = x @ params["embed"]["tokens"].astype(dt).T
+        else:
+            logits = x @ params["lm_head"]["w"].astype(dt)
+        return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+    return jax.jit(fwd, donate_argnums=(1,))
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+class InferenceEngineV2:
+    """Reference surface: ``put(uids, tokens) → logits/tokens``, plus a
+    convenience ``generate_all`` driving requests to completion."""
+
+    def __init__(self, model_config: tfm.TransformerConfig, params: Any,
+                 config: Optional[V2Config] = None):
+        self.cfg = config or V2Config()
+        self.model_cfg = dataclasses.replace(model_config, dtype=self.cfg.dtype)
+        self.params = params
+        # one block reserved as write-scratch for padded tokens
+        self.kv = KVCacheManager(self.cfg.num_blocks - 1, self.cfg.block_size,
+                                 self.cfg.max_blocks_per_seq)
+        self.builder = RaggedBatchBuilder(self.cfg.max_tokens_per_step,
+                                          self.cfg.max_seqs,
+                                          self.cfg.max_blocks_per_seq)
+        L = self.model_cfg.num_layers
+        shape = (L, self.cfg.num_blocks, self.cfg.block_size,
+                 self.model_cfg.kv_heads, self.model_cfg.head_dim)
+        dt = jnp.dtype(self.cfg.dtype)
+        self.caches = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        self._fwd = build_ragged_forward(self.model_cfg, self.cfg)
+        self._decode_fwd = build_decode_forward(self.model_cfg, self.cfg)
+        self.running: Dict[int, SequenceDescriptor] = {}
+        self.waiting: Deque[SequenceDescriptor] = deque()
+        self._uid = 0
+        self._rng = jax.random.PRNGKey(0)
+
+    # -- request API ---------------------------------------------------
+    def put(self, prompt_tokens: List[int], max_new_tokens: int = 64) -> int:
+        max_ctx = self.cfg.max_blocks_per_seq * self.cfg.block_size
+        need = len(prompt_tokens) + max_new_tokens
+        if need > max_ctx:
+            raise ValueError(
+                f"request needs {need} tokens of KV but max context is "
+                f"{max_ctx} (max_blocks_per_seq * block_size); an admitted "
+                "request could never be scheduled")
+        self._uid += 1
+        seq = SequenceDescriptor(uid=self._uid, tokens=list(prompt_tokens),
+                                 max_new_tokens=max_new_tokens)
+        self.waiting.append(seq)
+        return self._uid
+
+    def _schedule(self) -> List[Tuple[SequenceDescriptor, int]]:
+        """Dynamic SplitFuse: decode tokens first, then prefill chunks."""
+        budget = self.cfg.max_tokens_per_step
+        picks: List[Tuple[SequenceDescriptor, int]] = []
+        # running sequences: 1 decode token each (or remaining prefill)
+        for seq in list(self.running.values()):
+            if len(picks) >= self.cfg.max_seqs or budget <= 0:
+                break
+            n = min(seq.cur_len - seq.seen_tokens, budget) or 1
+            n = min(n, budget)
+            if not self.kv.ensure_capacity(seq, n):
+                continue  # stalled on memory this step
+            picks.append((seq, n))
+            budget -= n
+        # admit waiting sequences with prefill chunks. Admission reserves the
+        # request's ENTIRE block budget (prompt + max_new_tokens) up front so
+        # an admitted sequence can never stall mid-decode — without this the
+        # pool can be exhausted by half-admitted requests and livelock.
+        while self.waiting and budget > 0 and len(picks) < self.cfg.max_seqs:
+            seq = self.waiting[0]
+            n = min(seq.cur_len - seq.seen_tokens, budget)
+            total_needed = (seq.cur_len - seq.seen_tokens) + seq.max_new_tokens
+            if n <= 0 or not self.kv.ensure_capacity(seq, total_needed):
+                break
+            self.waiting.popleft()
+            self.running[seq.uid] = seq
+            picks.append((seq, n))
+            budget -= n
+        return picks
+
+    def step(self, temperature: float = 0.0, rng: Optional[jax.Array] = None
+             ) -> Dict[int, int]:
+        """One continuous-batching step → {uid: new_token} for sequences that
+        produced a token (prefill-finished or decode)."""
+        picks = self._schedule()
+        if not picks:
+            if self.running:
+                raise RuntimeError(
+                    "scheduler made no progress with running sequences — "
+                    "KV reservation invariant violated (bug)")
+            return {}
+        pure_decode = all(n == 1 and s.seen_tokens > 0 for s, n in picks)
+        if pure_decode:
+            # hot path: one token per sequence through the paged Pallas kernel
+            batch = self.builder.build(picks)
+            ns = len(picks)
+            tok = np.zeros(self.cfg.max_seqs, np.int32)
+            pos = np.zeros(self.cfg.max_seqs, np.int32)
+            tok[:ns] = batch.token_ids[:ns]
+            pos[:ns] = batch.position_ids[:ns]
+            logits, self.caches = self._decode_fwd(
+                self.params, self.caches, jnp.asarray(tok), jnp.asarray(pos),
+                jnp.asarray(batch.block_tables), jnp.asarray(batch.context_lens))
+        else:
+            batch = self.builder.build(picks)
+            logits, self.caches = self._fwd(
+                self.params, self.caches,
+                jnp.asarray(batch.token_ids), jnp.asarray(batch.position_ids),
+                jnp.asarray(batch.seq_index), jnp.asarray(batch.block_tables),
+                jnp.asarray(batch.context_lens), jnp.asarray(batch.logits_rows))
+        if temperature > 0.0:
+            if rng is None:
+                self._rng, rng = jax.random.split(self._rng)
+            sampled = jax.random.categorical(rng, logits / temperature, axis=-1)
+        else:
+            sampled = logits.argmax(-1)
+        sampled = np.asarray(sampled)
+
+        out: Dict[int, int] = {}
+        for row, (seq, n) in enumerate(picks):
+            seq.seen_tokens += n
+            if seq.seen_tokens >= seq.cur_len:  # produced a next token
+                tok = int(sampled[row])
+                seq.tokens.append(tok)
+                seq.generated += 1
+                out[seq.uid] = tok
+                if seq.generated >= seq.max_new_tokens:
+                    seq.done = True
+                    self.kv.release(seq)
+                    del self.running[seq.uid]
+        return out
+
+    def generate_all(self, temperature: float = 0.0, seed: int = 0,
+                     max_steps: int = 10000) -> Dict[int, List[int]]:
+        """Drive until every queued request completes."""
+        results: Dict[int, List[int]] = {}
+        tracked = {s.uid: s for s in list(self.waiting)} | dict(self.running)
+        rng = jax.random.PRNGKey(seed)
+        for _ in range(max_steps):
+            if not self.waiting and not self.running:
+                break
+            rng, step_rng = jax.random.split(rng)
+            self.step(temperature=temperature, rng=step_rng)
+        for uid, seq in tracked.items():
+            results[uid] = seq.tokens
+        return results

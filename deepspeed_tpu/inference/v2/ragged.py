@@ -1,0 +1,154 @@
+"""Ragged batching + paged KV cache management.
+
+Capability analogue of the reference's inference-v2 ragged stack
+(``inference/v2/ragged/`` — ``DSStateManager`` ragged_manager.py:19,
+``RaggedBatchWrapper`` ragged_wrapper.py:31, ``BlockedKVCache``
+kv_cache.py:40, ``BlockedAllocator`` blocked_allocator.py:11): sequences own
+chains of fixed-size KV blocks from a shared pool, so memory scales with
+tokens actually generated, and prefill/decode tokens from many requests batch
+into one ragged forward.
+
+TPU adaptation: XLA needs static shapes, so the "ragged" batch is a fixed
+(max_tokens,) token buffer + per-sequence block tables padded to
+``max_blocks_per_seq`` — the paged-attention kernel indexes KV through the
+block table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Free-list allocator over a fixed pool of KV blocks
+    (reference: ``blocked_allocator.py:11``)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks <= 0:
+            raise ValueError("num_blocks must be positive")
+        self._free: List[int] = list(range(num_blocks))
+        self.num_blocks = num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(
+                f"KV cache exhausted: requested {n} blocks, {len(self._free)} free")
+        out = self._free[:n]
+        del self._free[:n]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not (0 <= b < self.num_blocks):
+                raise ValueError(f"invalid block id {b}")
+        self._free.extend(blocks)
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    """Reference: ``sequence_descriptor.py`` — one tracked request."""
+
+    uid: int
+    tokens: List[int]
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    seen_tokens: int = 0  # tokens already in KV cache
+    max_new_tokens: int = 128
+    generated: int = 0
+    done: bool = False
+
+    @property
+    def cur_len(self) -> int:
+        return len(self.tokens)
+
+
+class KVCacheManager:
+    """Paged KV cache bookkeeping (host side).
+
+    The device-side cache is a (layers, num_blocks, block_size, kv_heads,
+    head_dim) array; this manager owns the allocator and per-sequence block
+    tables (reference ``BlockedKVCache``)."""
+
+    def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int):
+        self.allocator = BlockedAllocator(num_blocks)
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+
+    def blocks_needed(self, seq: SequenceDescriptor, new_tokens: int) -> int:
+        total = seq.seen_tokens + new_tokens
+        have = len(seq.blocks)
+        need = -(-total // self.block_size)  # ceil
+        return max(0, need - have)
+
+    def ensure_capacity(self, seq: SequenceDescriptor, new_tokens: int) -> bool:
+        need = self.blocks_needed(seq, new_tokens)
+        if len(seq.blocks) + need > self.max_blocks_per_seq:
+            return False
+        if need > self.allocator.free_blocks:
+            return False
+        if need:
+            seq.blocks.extend(self.allocator.allocate(need))
+        return True
+
+    def release(self, seq: SequenceDescriptor) -> None:
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+
+
+@dataclasses.dataclass
+class RaggedBatch:
+    """One scheduled forward (reference ``RaggedBatchWrapper``): flattened
+    tokens from every participating sequence + metadata the kernels need,
+    padded to static shapes."""
+
+    token_ids: np.ndarray  # (max_tokens,) int32
+    position_ids: np.ndarray  # (max_tokens,) int32 — position within its seq
+    seq_index: np.ndarray  # (max_tokens,) int32 — row in the block table
+    block_tables: np.ndarray  # (max_seqs, max_blocks_per_seq) int32
+    context_lens: np.ndarray  # (max_seqs,) int32 — tokens in cache AFTER this step
+    logits_rows: np.ndarray  # (max_seqs,) int32 — flat index of each seq's last token
+    num_tokens: int
+    num_seqs: int
+    uids: List[int]
+
+
+class RaggedBatchBuilder:
+    def __init__(self, max_tokens: int, max_seqs: int, max_blocks_per_seq: int):
+        self.max_tokens = max_tokens
+        self.max_seqs = max_seqs
+        self.max_blocks_per_seq = max_blocks_per_seq
+
+    def build(self, seqs: List[Tuple[SequenceDescriptor, int]]) -> RaggedBatch:
+        """seqs: (descriptor, n_new_tokens) pairs already capacity-checked."""
+        if len(seqs) > self.max_seqs:
+            raise ValueError(f"{len(seqs)} sequences > max_seqs {self.max_seqs}")
+        token_ids = np.zeros(self.max_tokens, np.int32)
+        position_ids = np.zeros(self.max_tokens, np.int32)
+        seq_index = np.full(self.max_tokens, -1, np.int32)
+        block_tables = np.zeros((self.max_seqs, self.max_blocks_per_seq), np.int32)
+        context_lens = np.zeros(self.max_seqs, np.int32)
+        logits_rows = np.zeros(self.max_seqs, np.int32)
+        uids = []
+        cursor = 0
+        for row, (seq, n_new) in enumerate(seqs):
+            start = seq.seen_tokens
+            new_tokens = seq.tokens[start:start + n_new]
+            if cursor + len(new_tokens) > self.max_tokens:
+                raise ValueError("ragged batch token budget exceeded")
+            sl = slice(cursor, cursor + len(new_tokens))
+            token_ids[sl] = new_tokens
+            position_ids[sl] = np.arange(start, start + len(new_tokens))
+            seq_index[sl] = row
+            block_tables[row, :len(seq.blocks)] = seq.blocks
+            context_lens[row] = start + len(new_tokens)
+            logits_rows[row] = cursor + len(new_tokens) - 1
+            cursor += len(new_tokens)
+            uids.append(seq.uid)
+        return RaggedBatch(token_ids, position_ids, seq_index, block_tables,
+                           context_lens, logits_rows, cursor, len(seqs), uids)

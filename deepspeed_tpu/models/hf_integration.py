@@ -1,0 +1,176 @@
+"""HuggingFace model integration (AutoTP role).
+
+Capability analogue of the reference's ``module_inject/auto_tp.py`` +
+``inference/v2/checkpoint`` HF loading: map HF transformer checkpoints
+(LLaMA / GPT-2 family state dicts) onto this framework's param pytree, with
+tensor-parallel sharding applied by the usual logical-axis rules — checkpoint
+-level AutoTP instead of nn.Module surgery (there are no modules to patch in
+a functional model zoo).
+
+Also provides the reverse export so trained params can be saved back into an
+HF-compatible state dict (the ``save_16bit_model`` / zero_to_fp32 role).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from . import transformer as tfm
+
+
+def config_from_hf(hf_config) -> tfm.TransformerConfig:
+    """Map an HF config object/dict (LlamaConfig, GPT2Config, MixtralConfig)
+    to a TransformerConfig."""
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    model_type = get("model_type", "llama")
+    if model_type == "gpt2":
+        return tfm.TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("n_embd"),
+            intermediate_size=4 * get("n_embd"), num_layers=get("n_layer"),
+            num_heads=get("n_head"), max_seq_len=get("n_positions", 1024),
+            norm="layernorm", activation="gelu", position="learned",
+            tie_embeddings=True)
+    num_experts = get("num_local_experts", 0) or 0
+    return tfm.TransformerConfig(
+        vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        num_kv_heads=get("num_key_value_heads"),
+        max_seq_len=get("max_position_embeddings", 4096),
+        rope_theta=get("rope_theta", 10000.0),
+        norm_eps=get("rms_norm_eps", 1e-5),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+        num_experts=num_experts,
+        moe_top_k=get("num_experts_per_tok", 2) if num_experts else 2,
+    )
+
+
+def _stack(tensors) -> np.ndarray:
+    return np.stack([np.asarray(t) for t in tensors])
+
+
+def params_from_hf_llama(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
+                         ) -> Dict[str, Any]:
+    """LLaMA/Mistral-family HF state_dict → stacked param pytree.
+
+    HF nn.Linear stores (out, in); our params are (in, out) → transpose.
+    """
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L = cfg.num_layers
+
+    def lw(pattern):  # stacked, transposed linear weights
+        return _stack([sd[pattern.format(i)].T for i in range(L)])
+
+    def lnorm(pattern):
+        return _stack([sd[pattern.format(i)] for i in range(L)])
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": sd["model.embed_tokens.weight"]},
+        "layers": {
+            "attn": {
+                "wq": lw("model.layers.{}.self_attn.q_proj.weight"),
+                "wk": lw("model.layers.{}.self_attn.k_proj.weight"),
+                "wv": lw("model.layers.{}.self_attn.v_proj.weight"),
+                "wo": lw("model.layers.{}.self_attn.o_proj.weight"),
+            },
+            "ln1": {"scale": lnorm("model.layers.{}.input_layernorm.weight")},
+            "ln2": {"scale": lnorm(
+                "model.layers.{}.post_attention_layernorm.weight")},
+            "mlp": {
+                "w_gate": lw("model.layers.{}.mlp.gate_proj.weight"),
+                "w_in": lw("model.layers.{}.mlp.up_proj.weight"),
+                "w_out": lw("model.layers.{}.mlp.down_proj.weight"),
+            },
+        },
+        "final_norm": {"scale": sd["model.norm.weight"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"w": sd["lm_head.weight"].T}
+    return params
+
+
+def params_from_hf_gpt2(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
+                        ) -> Dict[str, Any]:
+    """GPT-2 HF state_dict → param pytree.  GPT-2 uses Conv1D ((in, out),
+    no transpose) and a fused c_attn; note our blocks are bias-free — biases
+    are folded away (exactness preserved only for bias-free finetunes)."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, h = cfg.num_layers, cfg.hidden_size
+
+    qs, ks, vs, wos, w_ins, w_outs = [], [], [], [], [], []
+    ln1s, ln1b, ln2s, ln2b = [], [], [], []
+    for i in range(L):
+        c_attn = sd[f"h.{i}.attn.c_attn.weight"]  # (h, 3h)
+        qs.append(c_attn[:, :h])
+        ks.append(c_attn[:, h:2 * h])
+        vs.append(c_attn[:, 2 * h:])
+        wos.append(sd[f"h.{i}.attn.c_proj.weight"])
+        w_ins.append(sd[f"h.{i}.mlp.c_fc.weight"])
+        w_outs.append(sd[f"h.{i}.mlp.c_proj.weight"])
+        ln1s.append(sd[f"h.{i}.ln_1.weight"])
+        ln1b.append(sd[f"h.{i}.ln_1.bias"])
+        ln2s.append(sd[f"h.{i}.ln_2.weight"])
+        ln2b.append(sd[f"h.{i}.ln_2.bias"])
+
+    return {
+        "embed": {"tokens": sd["wte.weight"], "position": sd["wpe.weight"]},
+        "layers": {
+            "attn": {"wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
+                     "wo": _stack(wos)},
+            "ln1": {"scale": _stack(ln1s), "bias": _stack(ln1b)},
+            "ln2": {"scale": _stack(ln2s), "bias": _stack(ln2b)},
+            "mlp": {"w_in": _stack(w_ins), "w_out": _stack(w_outs)},
+        },
+        "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+
+
+def params_to_hf_llama(params: Dict[str, Any], cfg: tfm.TransformerConfig
+                       ) -> Dict[str, np.ndarray]:
+    """Reverse export (save_16bit_model / zero_to_fp32 role)."""
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["tokens"]),
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
+    }
+    lp = params["layers"]
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}"
+        out[f"{pre}.self_attn.q_proj.weight"] = np.asarray(lp["attn"]["wq"][i]).T
+        out[f"{pre}.self_attn.k_proj.weight"] = np.asarray(lp["attn"]["wk"][i]).T
+        out[f"{pre}.self_attn.v_proj.weight"] = np.asarray(lp["attn"]["wv"][i]).T
+        out[f"{pre}.self_attn.o_proj.weight"] = np.asarray(lp["attn"]["wo"][i]).T
+        out[f"{pre}.mlp.gate_proj.weight"] = np.asarray(lp["mlp"]["w_gate"][i]).T
+        out[f"{pre}.mlp.up_proj.weight"] = np.asarray(lp["mlp"]["w_in"][i]).T
+        out[f"{pre}.mlp.down_proj.weight"] = np.asarray(lp["mlp"]["w_out"][i]).T
+        out[f"{pre}.input_layernorm.weight"] = np.asarray(lp["ln1"]["scale"][i])
+        out[f"{pre}.post_attention_layernorm.weight"] = \
+            np.asarray(lp["ln2"]["scale"][i])
+    if not cfg.tie_embeddings and "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]["w"]).T
+    return out
+
+
+def load_hf_model(model_name_or_sd, hf_config=None,
+                  ) -> tuple:
+    """One-call loader: (TransformerConfig, params).  Accepts a transformers
+    PreTrainedModel, or (state_dict, config) pair."""
+    if hasattr(model_name_or_sd, "state_dict"):  # a transformers model
+        hf_config = model_name_or_sd.config
+        sd = {k: v.detach().cpu().numpy()
+              for k, v in model_name_or_sd.state_dict().items()}
+        # strip common prefixes
+        if any(k.startswith("transformer.") for k in sd):
+            sd = {k.removeprefix("transformer."): v for k, v in sd.items()}
+    else:
+        sd = model_name_or_sd
+    cfg = config_from_hf(hf_config)
+    model_type = (hf_config.get("model_type", "llama")
+                  if isinstance(hf_config, dict)
+                  else getattr(hf_config, "model_type", "llama"))
+    if model_type == "gpt2":
+        return cfg, params_from_hf_gpt2(sd, cfg)
+    return cfg, params_from_hf_llama(sd, cfg)

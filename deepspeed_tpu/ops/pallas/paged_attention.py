@@ -1,0 +1,167 @@
+"""Paged-KV decode attention Pallas kernel.
+
+Capability analogue of the reference's blocked/ragged attention kernels
+(``inference/v2/kernels/ragged_ops/blocked_flash`` and
+``linear_blocked_kv_rotary``): one query token per sequence attends over its
+chain of KV blocks, indexed through a block table — the continuous-batching
+decode hot loop.
+
+Kernel shape: grid over sequences; the block table arrives via scalar
+prefetch (SMEM) so each step can DMA the right KV block HBM→VMEM with double
+buffering while computing the previous one; online softmax across blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _decode_attention_xla(q, k_cache, v_cache, block_tables, context_lens):
+    """Gather-based decode fallback for kernel-unfriendly shapes."""
+    S, H, D = q.shape
+    NB, BS, KV, _ = k_cache.shape
+    S_max = block_tables.shape[1] * BS
+    k_seq = k_cache[block_tables].reshape(S, S_max, KV, D)
+    v_seq = v_cache[block_tables].reshape(S, S_max, KV, D)
+    if KV != H:
+        rep = H // KV
+        k_seq = jnp.repeat(k_seq, rep, axis=2)
+        v_seq = jnp.repeat(v_seq, rep, axis=2)
+    scores = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+                        k_seq.astype(jnp.float32)) / math.sqrt(D)
+    pos = jnp.arange(S_max)[None, None, :]
+    scores = jnp.where(pos < context_lens[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("sht,sthd->shd", probs, v_seq.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _decode_kernel(block_tables_ref, context_lens_ref,  # scalar prefetch
+                   q_ref, k_hbm, v_hbm,  # inputs
+                   o_ref,  # output
+                   k_buf, v_buf, copy_sems,  # scratch
+                   *, block_size: int, max_blocks: int, group: int):
+    s = pl.program_id(0)
+    ctx = context_lens_ref[s]
+    nblocks = pl.cdiv(ctx, block_size)
+
+    q = q_ref[0].astype(jnp.float32)  # (H, D)
+    H, D = q.shape
+    KV = H // group
+    scale = 1.0 / math.sqrt(D)
+    qs = q * scale
+    # per-(head, kv·slot) validity: head h may only read kv head h//group.
+    # Keeping invalid columns at -inf → p=0 → the p@v matmul combines exactly.
+    head_kv = jax.lax.broadcasted_iota(jnp.int32, (H, KV * block_size), 0) // group
+    col_kv = jax.lax.broadcasted_iota(jnp.int32, (H, KV * block_size), 1) // block_size
+    kv_match = head_kv == col_kv
+    col_pos = jax.lax.broadcasted_iota(jnp.int32, (H, KV * block_size), 1) % block_size
+
+    def get_dma(slot, j):
+        blk = block_tables_ref[s, j]
+        return (pltpu.make_async_copy(k_hbm.at[blk], k_buf.at[slot],
+                                      copy_sems.at[slot, 0]),
+                pltpu.make_async_copy(v_hbm.at[blk], v_buf.at[slot],
+                                      copy_sems.at[slot, 1]))
+
+    @pl.when(nblocks > 0)
+    def _start_first():
+        ka, va = get_dma(0, 0)
+        ka.start()
+        va.start()
+
+    def body(j, carry):
+        acc, m, l = carry
+        slot = j % 2
+
+        @pl.when(j + 1 < nblocks)
+        def _prefetch_next():
+            ka, va = get_dma((j + 1) % 2, j + 1)
+            ka.start()
+            va.start()
+
+        ka, va = get_dma(slot, j)
+        ka.wait()
+        va.wait()
+        # (bs, KV, D) → (KV·bs, D): kv-major so column c maps to kv c//bs
+        k = k_buf[slot].astype(jnp.float32).transpose(1, 0, 2) \
+            .reshape(KV * block_size, D)
+        v = v_buf[slot].astype(jnp.float32).transpose(1, 0, 2) \
+            .reshape(KV * block_size, D)
+
+        scores = jax.lax.dot_general(
+            qs, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (H, KV·bs)
+        pos = j * block_size + col_pos
+        scores = jnp.where(kv_match & (pos < ctx), scores, -jnp.inf)
+
+        m_cur = jnp.max(scores, axis=1, keepdims=True)  # (H, 1)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)  # invalid cols → 0
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (H, D)
+        acc_new = acc * alpha + pv
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((H, D), jnp.float32)
+    m0 = jnp.full((H, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((H, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nblocks, body, (acc0, m0, l0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                           block_tables: jax.Array, context_lens: jax.Array
+                           ) -> jax.Array:
+    """q: (max_seqs, H, D) — one decode token per sequence.
+    k/v_cache: (num_blocks, block_size, KV, D); block_tables:
+    (max_seqs, max_blocks) int32; context_lens: (max_seqs,) int32.
+    Context length INCLUDES the current token (its KV already written)."""
+    S, H, D = q.shape
+    NB, BS, KV, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    group = H // KV
+
+    # Mosaic DMA slices need the lane dim 128-aligned and sublanes 8-aligned;
+    # small-model shapes fall back to the (correct, slower) XLA gather path.
+    if not _interpret() and (D % 128 != 0 or BS % 8 != 0):
+        return _decode_attention_xla(q, k_cache, v_cache, block_tables,
+                                     context_lens)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda s, *_: (s, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda s, *_: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, BS, KV, D), k_cache.dtype),
+            pltpu.VMEM((2, BS, KV, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, block_size=BS, max_blocks=max_blocks,
+                          group=group),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        interpret=_interpret(),
+    )(block_tables, context_lens, q, k_cache, v_cache)

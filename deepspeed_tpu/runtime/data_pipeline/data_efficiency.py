@@ -1,0 +1,120 @@
+"""Data-efficiency suite: curriculum learning, efficient sampling, random-LTD.
+
+Capability analogue of the reference's ``runtime/data_pipeline/``:
+* ``CurriculumScheduler`` (curriculum_scheduler.py:11) — difficulty schedule
+  over steps (here: sequence-length curriculum with fixed_linear /
+  fixed_root / fixed_discrete schedules, same config keys);
+* ``DeepSpeedDataSampler`` (data_sampling/data_sampler.py:36) — difficulty-
+  bucketed deterministic sampling;
+* random-LTD (data_routing/basic_layer.py RandomLayerTokenDrop) — per-layer
+  random token dropping with a token-budget schedule; TPU-native form keeps
+  static shapes by *gathering* a fixed-size token subset per layer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.logging import log_dist
+
+
+class CurriculumScheduler:
+    """Reference: ``curriculum_scheduler.py`` — same schedule_type names."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.min_difficulty = int(config.get("min_difficulty", 8))
+        self.max_difficulty = int(config.get("max_difficulty", 1024))
+        self.schedule_type = config.get("schedule_type", "fixed_linear")
+        sc = config.get("schedule_config", {})
+        self.total_step = int(sc.get("total_curriculum_step", 10000))
+        self.difficulty_step = int(sc.get("difficulty_step", 8))
+        self.root_degree = int(sc.get("root_degree", 2))
+        self.difficulties: List[int] = list(sc.get("difficulty", []))
+        self.max_step: List[int] = list(sc.get("max_step", []))
+
+    def get_difficulty(self, global_step: int) -> int:
+        t = min(max(global_step, 0), self.total_step)
+        if self.schedule_type == "fixed_linear":
+            frac = t / self.total_step
+        elif self.schedule_type == "fixed_root":
+            frac = (t / self.total_step) ** (1.0 / self.root_degree)
+        elif self.schedule_type == "fixed_discrete":
+            d = self.min_difficulty
+            for diff, step in zip(self.difficulties, self.max_step):
+                if global_step >= step:
+                    d = diff
+            return int(d)
+        else:
+            raise ValueError(f"unknown schedule_type {self.schedule_type!r}")
+        diff = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        diff = int(diff // self.difficulty_step * self.difficulty_step)
+        return max(self.min_difficulty, min(diff, self.max_difficulty))
+
+    def truncate_batch(self, batch: Dict[str, np.ndarray], global_step: int,
+                       seq_keys: Sequence[str] = ("input_ids", "labels", "loss_mask")
+                       ) -> Dict[str, np.ndarray]:
+        """Sequence-length curriculum: truncate the seq axis to the current
+        difficulty (reference: engine's curriculum hook on the batch)."""
+        diff = self.get_difficulty(global_step)
+        out = dict(batch)
+        for k in seq_keys:
+            if k in out and out[k].ndim >= 2 and out[k].shape[1] > diff:
+                out[k] = out[k][:, :diff]
+        return out
+
+
+class DifficultyBucketedSampler:
+    """Reference: ``DeepSpeedDataSampler`` — deterministic, difficulty-aware
+    index sampling; difficulty values are provided per example (e.g. length)."""
+
+    def __init__(self, difficulties: np.ndarray, batch_size: int, seed: int = 0):
+        self.difficulties = np.asarray(difficulties)
+        self.order = np.argsort(self.difficulties, kind="stable")
+        self.batch_size = batch_size
+        self.seed = seed
+
+    def batches_for_difficulty(self, max_difficulty: int,
+                               epoch: int = 0) -> List[np.ndarray]:
+        eligible = self.order[self.difficulties[self.order] <= max_difficulty]
+        rng = np.random.default_rng(self.seed + epoch)
+        eligible = rng.permutation(eligible)
+        n = len(eligible) // self.batch_size
+        return [eligible[i * self.batch_size:(i + 1) * self.batch_size]
+                for i in range(n)]
+
+
+class RandomLTDScheduler:
+    """random layer-token-drop budget (reference: data_routing/scheduler.py):
+    tokens kept per middle layer grows linearly from min to full."""
+
+    def __init__(self, total_steps: int, min_keep_ratio: float = 0.5,
+                 reserved_length: Optional[int] = None):
+        self.total_steps = max(1, total_steps)
+        self.min_keep_ratio = min_keep_ratio
+
+    def keep_ratio(self, step: int) -> float:
+        frac = min(step / self.total_steps, 1.0)
+        return self.min_keep_ratio + (1.0 - self.min_keep_ratio) * frac
+
+
+def random_ltd_gather(x: jax.Array, rng: jax.Array, keep: int):
+    """Drop tokens: keep a random fixed-size subset (static shape).
+    x: (B, S, H) → (x_kept (B, keep, H), indices (B, keep)).
+    TPU equivalent of ``csrc/random_ltd`` token_sort/gather kernels —
+    jnp.take_along_axis lowers to efficient dynamic-gather."""
+    B, S, _ = x.shape
+    noise = jax.random.uniform(rng, (B, S))
+    idx = jnp.argsort(noise, axis=1)[:, :keep]
+    idx = jnp.sort(idx, axis=1)  # keep temporal order
+    return jnp.take_along_axis(x, idx[..., None], axis=1), idx
+
+
+def random_ltd_scatter(x_full: jax.Array, x_kept: jax.Array, idx: jax.Array):
+    """Scatter processed kept-tokens back; dropped tokens keep their input
+    (the residual skip of RandomLayerTokenDrop)."""
+    return x_full.at[jnp.arange(x_full.shape[0])[:, None], idx].set(x_kept)

@@ -92,11 +92,19 @@ class TrainingEngine:
         # ---- topology -------------------------------------------------
         if topo is None:
             mesh_cfg = config.mesh
-            if config.zero_optimization.stage >= 3:
-                # ZeRO-3 shards params over the whole DP world: fold dp→fsdp
-                from .config import MeshConfig
-                from .config_utils import is_auto
+            from .config import MeshConfig
+            from .config_utils import is_auto
 
+            mics = config.zero_optimization.mics_shard_size
+            if config.zero_optimization.stage >= 3 and mics > 0:
+                # MiCS (reference runtime/zero/mics.py): shard params within
+                # groups of mics_shard_size, replicate across groups — i.e.
+                # fsdp = shard size, dp = the replica groups
+                mesh_cfg = MeshConfig(**{
+                    **mesh_cfg.model_dump(),
+                    "fsdp_size": mics, "data_parallel_size": "auto"})
+            elif config.zero_optimization.stage >= 3:
+                # ZeRO-3 shards params over the whole DP world: fold dp→fsdp
                 if is_auto(mesh_cfg.fsdp_size) or int(mesh_cfg.fsdp_size) == 1:
                     mesh_cfg = MeshConfig(**{
                         **mesh_cfg.model_dump(),
