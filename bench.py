@@ -30,20 +30,28 @@ def main() -> None:
     if on_tpu:
         # ~0.6B-param LLaMA-architecture model: big enough to saturate the MXU,
         # small enough (bf16 params+grads+adam on 16G HBM) for one v5e chip.
+        # Flash attention + ALST tiled logits/loss (the (B,S,V) fp32 logits
+        # would otherwise cap the batch) → micro-batch 24.
         cfg = tfm.get_config(
             "llama3-8b", num_layers=12, hidden_size=2048,
             intermediate_size=5632, num_heads=16, num_kv_heads=8,
             vocab_size=32000, max_seq_len=2048, param_dtype="bfloat16",
             attn_impl="flash")
-        micro, seq, steps, warmup = 8, 2048, 10, 3
+        micro, seq, steps, warmup = 24, 2048, 10, 3
     else:  # CI smoke path
         cfg = tfm.get_config("tiny")
         micro, seq, steps, warmup = 2, 128, 3, 1
 
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
 
-    def loss_fn(p, batch, rng):
-        return tfm.loss_fn(p, batch, cfg)
+    if on_tpu:
+        from deepspeed_tpu.sequence.tiled_compute import tiled_loss_fn
+
+        def loss_fn(p, batch, rng):
+            return tiled_loss_fn(p, batch, cfg, tile_size=512)
+    else:
+        def loss_fn(p, batch, rng):
+            return tfm.loss_fn(p, batch, cfg)
 
     spec = ModelSpec(loss_fn=loss_fn, params=params,
                      param_axes=tfm.param_axes(cfg))

@@ -1,0 +1,102 @@
+"""Activation checkpointing / rematerialisation.
+
+Capability analogue of the reference's ``runtime/activation_checkpointing/
+checkpointing.py`` (Megatron-style ``CheckpointFunction:488``,
+``partition_activations:377``, CPU checkpointing, RNG trackers).  TPU-native
+mapping:
+
+* checkpoint/recompute  → ``jax.checkpoint`` with a named policy;
+* partition_activations → sharding the saved residuals over tp/sp via
+  ``jax.lax.with_sharding_constraint`` inside the checkpointed body;
+* cpu_checkpointing     → ``save_and_offload_only_these_names`` — residuals
+  move to pinned host memory between forward and backward;
+* RNG trackers          → unnecessary: jax threading of explicit PRNG keys
+  makes recompute determinism structural.
+
+``configure()``/``checkpoint()`` mirror the reference's module surface.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+
+from ...utils.logging import logger
+from ..config import ActivationCheckpointingConfig
+
+_config = ActivationCheckpointingConfig()
+
+
+def configure(config: Optional[ActivationCheckpointingConfig] = None, **kwargs) -> None:
+    """Reference: ``checkpointing.configure`` (:1032)."""
+    global _config
+    if config is not None:
+        _config = config
+    for k, v in kwargs.items():
+        setattr(_config, k, v)
+
+
+def get_policy(cfg: Optional[ActivationCheckpointingConfig] = None):
+    cfg = cfg or _config
+    pols = jax.checkpoint_policies
+    if cfg.cpu_checkpointing:
+        # offload every saveable residual to host memory (ZeRO-R CPU ckpt)
+        try:
+            return pols.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=["ckpt"],
+                offload_src="device", offload_dst="pinned_host")
+        except Exception:  # pragma: no cover - older jax
+            logger.warning("host-offload remat unavailable; using recompute-all")
+            return pols.nothing_saveable
+    name = cfg.policy
+    mapping = {
+        "everything": pols.everything_saveable,
+        "nothing": pols.nothing_saveable,
+        "nothing_saveable": pols.nothing_saveable,
+        "dots": pols.dots_saveable,
+        "dots_saveable": pols.dots_saveable,
+        "dots_with_no_batch_dims": pols.dots_with_no_batch_dims_saveable,
+        "dots_with_no_batch_dims_saveable": pols.dots_with_no_batch_dims_saveable,
+    }
+    if name not in mapping:
+        raise ValueError(f"unknown activation-checkpoint policy {name!r}")
+    return mapping[name]
+
+
+def checkpoint(fn: Callable, *args,
+               cfg: Optional[ActivationCheckpointingConfig] = None, **kwargs):
+    """Reference surface: ``deepspeed.checkpointing.checkpoint(fn, *args)`` —
+    run ``fn`` under remat with the configured policy."""
+    cfg = cfg or _config
+    wrapped = jax.checkpoint(fn, policy=get_policy(cfg), prevent_cse=False)
+    return wrapped(*args, **kwargs)
+
+
+def checkpoint_name(x: Any, name: str = "ckpt") -> Any:
+    """Tag an intermediate so offload/save policies can reference it by name
+    (jax.ad_checkpoint.checkpoint_name)."""
+    from jax.ad_checkpoint import checkpoint_name as _cn
+
+    return _cn(x, name)
+
+
+def partition_activations_constraint(x: jax.Array, axes=("tp",)) -> jax.Array:
+    """Shard a saved residual over model-parallel axes (reference
+    ``partition_activations``): under GSPMD this is a sharding constraint on
+    the tagged tensor."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ...parallel.topology import get_topology
+
+    topo = get_topology()
+    usable = [a for a in axes if topo.size(a) > 1]
+    if not usable or x.ndim < 2:
+        return x
+    spec = [None] * x.ndim
+    if x.shape[-1] % topo.size(usable[0]) == 0:
+        spec[-1] = usable[0]
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(topo.mesh, P(*spec)))

@@ -1,0 +1,106 @@
+"""ZenFlow — importance-aware selective updates for stall-free offloading.
+
+Capability analogue of the reference's ``runtime/zenflow/``
+(``zenflow_stage_1_and_2.py`` + ``ops/adam/zenflow_torch_adam.py``): the
+top-k most important gradient columns are applied *immediately* (on device,
+cheap), while the long tail accumulates and is applied on the host
+asynchronously every ``update_interval`` steps — eliminating the per-step
+device stall of full optimizer offload (>4000× gradient-traffic reduction
+claim in the reference blog).
+
+Functional decomposition here:
+* ``select_topk_columns`` — per-matrix column importance (squared-grad norm),
+  reference's per-column proxy;
+* ``zenflow_partition`` — split a grad pytree into (hot, cold) by the masks;
+* ``ZenFlowOptimizer`` — device applies hot updates each step; cold grads
+  accumulate on host and a full (offloaded) update runs every
+  ``update_interval`` steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .config import ZenFlowConfig
+
+
+def select_topk_columns(grad: jax.Array, topk_ratio: float) -> jax.Array:
+    """Boolean column mask (last axis) of the top-k columns by grad energy.
+    Reference: ZenFlow's per-column importance proxy."""
+    if grad.ndim < 2:
+        return jnp.ones(grad.shape, bool)
+    energy = jnp.sum(jnp.square(grad), axis=tuple(range(grad.ndim - 1)))
+    k = max(1, int(energy.shape[0] * topk_ratio))
+    thresh = jnp.sort(energy)[-k]
+    keep = energy >= thresh
+    return jnp.broadcast_to(keep, grad.shape)
+
+
+def zenflow_partition(grads: Any, topk_ratio: float) -> Tuple[Any, Any]:
+    """→ (hot, cold): hot = top-k columns (rest zeroed), cold = complement."""
+    masks = jax.tree.map(lambda g: select_topk_columns(g, topk_ratio), grads)
+    hot = jax.tree.map(lambda g, m: g * m.astype(g.dtype), grads, masks)
+    cold = jax.tree.map(lambda g, m: g * (~m).astype(g.dtype), grads, masks)
+    return hot, cold
+
+
+class ZenFlowOptimizer:
+    """Wraps a device optimizer (hot path) + a host accumulator (cold path).
+
+    step(params, grads) → new params. Device update applies only the hot
+    columns every step; cold gradients accumulate host-side and flush through
+    the same optimizer every ``update_interval`` steps (the reference's
+    asynchronous CPU update, synchronous here but off the per-step critical
+    path by construction of the interval)."""
+
+    def __init__(self, optimizer: optax.GradientTransformation, params: Any,
+                 cfg: ZenFlowConfig):
+        self.optimizer = optimizer
+        self.cfg = cfg
+        self.update_interval = (4 if cfg.update_interval in (None, "auto")
+                                else int(cfg.update_interval))
+        self.opt_state = optimizer.init(params)
+        self._cold_acc = jax.tree.map(
+            lambda p: np.zeros(p.shape, np.float32), params)
+        self._step = 0
+
+        def hot_update(params, grads, opt_state):
+            masks = jax.tree.map(
+                lambda g: select_topk_columns(g, cfg.topk_ratio), grads)
+            hot = jax.tree.map(lambda g, m: g * m.astype(g.dtype), grads, masks)
+            cold = jax.tree.map(lambda g, m: g * (~m).astype(g.dtype),
+                                grads, masks)
+            updates, new_state = optimizer.update(hot, opt_state, params)
+            # mask the UPDATES too: the shared momentum would otherwise keep
+            # nudging cold columns every step from stale state, double-applying
+            # cold gradients between flushes
+            updates = jax.tree.map(lambda u, m: u * m.astype(u.dtype),
+                                   updates, masks)
+            return optax.apply_updates(params, updates), new_state, cold
+
+        def cold_update(params, cold_sum, opt_state):
+            updates, new_state = optimizer.update(cold_sum, opt_state, params)
+            return optax.apply_updates(params, updates), new_state
+
+        self._hot = jax.jit(hot_update)
+        self._cold = jax.jit(cold_update)
+
+    def step(self, params: Any, grads: Any) -> Any:
+        self._step += 1
+        params, self.opt_state, cold = self._hot(params, grads, self.opt_state)
+        cold_host = jax.device_get(cold)
+        self._cold_acc = jax.tree.map(lambda a, c: a + np.asarray(c, np.float32),
+                                      self._cold_acc, cold_host)
+        if self._step % self.update_interval == 0:
+            scale = 1.0 / self.update_interval
+            cold_mean = jax.tree.map(lambda a: jnp.asarray(a * scale),
+                                     self._cold_acc)
+            params, self.opt_state = self._cold(params, cold_mean, self.opt_state)
+            self._cold_acc = jax.tree.map(lambda a: a * 0.0, self._cold_acc)
+        return params

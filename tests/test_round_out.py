@@ -1,0 +1,142 @@
+"""Tests for the round-out modules: activation checkpointing, comms benchmark,
+ZenFlow, FPDT chunked attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepspeed_tpu.models.transformer import xla_attention
+
+
+# ---------------------------------------------------------------------------
+# activation checkpointing
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_policies_and_equivalence(devices):
+    from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ck
+    from deepspeed_tpu.runtime.config import ActivationCheckpointingConfig
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 32))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 32))
+
+    def block(w, x):
+        return jnp.tanh(x @ w) @ w.T
+
+    for policy in ("nothing", "dots", "everything"):
+        cfg = ActivationCheckpointingConfig(policy=policy)
+        g1 = jax.grad(lambda w: ck.checkpoint(block, w, x, cfg=cfg).sum())(w)
+        g2 = jax.grad(lambda w: block(w, x).sum())(w)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
+
+
+def test_checkpoint_bad_policy():
+    from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ck
+    from deepspeed_tpu.runtime.config import ActivationCheckpointingConfig
+
+    with pytest.raises(ValueError):
+        ck.get_policy(ActivationCheckpointingConfig(policy="bogus"))
+
+
+# ---------------------------------------------------------------------------
+# comms benchmark
+# ---------------------------------------------------------------------------
+
+
+def test_comms_benchmark_runs(devices):
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.parallel.topology import MeshTopology
+    from deepspeed_tpu.profiling.comms_benchmark import run_comms_benchmark
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    topo = MeshTopology.from_config(MeshConfig())
+    comm.configure(enabled=True)
+    res = run_comms_benchmark(topo, axis="dp", sizes_mb=(0.5,), n_iters=2)
+    ops = {r["op"] for r in res}
+    assert ops == {"all_reduce", "all_gather", "reduce_scatter", "all_to_all"}
+    assert all(r["algbw_GBps"] > 0 for r in res)
+    summary = comm.log_summary()
+    assert "all_reduce@dp" in summary
+    comm.configure(enabled=False)
+
+
+# ---------------------------------------------------------------------------
+# zenflow
+# ---------------------------------------------------------------------------
+
+
+def test_zenflow_topk_selection():
+    from deepspeed_tpu.runtime.zenflow import select_topk_columns
+
+    g = jnp.zeros((4, 10)).at[:, 3].set(5.0).at[:, 7].set(1.0)
+    mask = select_topk_columns(g, topk_ratio=0.2)  # top 2 of 10 columns
+    assert bool(mask[0, 3]) and bool(mask[0, 7])
+    assert int(mask[0].sum()) == 2
+
+
+def test_zenflow_trains(devices):
+    from deepspeed_tpu.runtime.config import ZenFlowConfig
+    from deepspeed_tpu.runtime.zenflow import ZenFlowOptimizer
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {"w": jax.random.normal(k1, (16, 8)) * 0.5}
+    x = jax.random.normal(k2, (64, 16))
+    y = x @ jax.random.normal(jax.random.PRNGKey(3), (16, 8))
+
+    zf = ZenFlowOptimizer(optax.adam(5e-2), params,
+                          ZenFlowConfig(enabled=True, topk_ratio=0.25,
+                                        update_interval=2))
+    loss_fn = lambda p: jnp.mean((x @ p["w"] - y) ** 2)
+    losses = []
+    for _ in range(60):
+        losses.append(float(loss_fn(params)))
+        grads = jax.grad(loss_fn)(params)
+        params = zf.step(params, grads)
+    assert losses[-1] < losses[0] * 0.25, (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# FPDT chunked attention
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_chunked_attention_matches_dense(devices, causal):
+    from deepspeed_tpu.sequence.fpdt import chunked_attention
+
+    B, S, H, D = 2, 64, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D))
+    k = jax.random.normal(ks[1], (B, S, H, D))
+    v = jax.random.normal(ks[2], (B, S, H, D))
+    out = chunked_attention(q, k, v, chunk_size=16, causal=causal)
+    ref = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_chunked_attention_gradients(devices):
+    from deepspeed_tpu.sequence.fpdt import chunked_attention
+
+    B, S, H, D = 1, 32, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D)) for kk in ks)
+    g1 = jax.grad(lambda q: (chunked_attention(q, k, v, 8) ** 2).sum())(q)
+    g2 = jax.grad(lambda q: (xla_attention(q, k, v, causal=True) ** 2).sum())(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+
+def test_fpdt_as_model_attention(devices):
+    from deepspeed_tpu.models import transformer as tfm
+    from deepspeed_tpu.sequence.fpdt import fpdt_attention
+
+    cfg = tfm.get_config("tiny", dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = np.random.default_rng(0).integers(0, 256, (1, 64)).astype(np.int32)
+    l_fpdt = tfm.forward(params, tokens, cfg,
+                         attn_fn=fpdt_attention(chunk_size=16, offload_kv=False))
+    l_ref = tfm.forward(params, tokens, cfg)
+    np.testing.assert_allclose(np.asarray(l_fpdt), np.asarray(l_ref),
+                               atol=1e-4, rtol=1e-4)
