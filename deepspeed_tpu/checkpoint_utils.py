@@ -1,0 +1,97 @@
+"""Checkpoint conversion CLI.
+
+Capability analogue of the reference's ``utils/zero_to_fp32.py`` (790 LoC
+offline shard-merging script) and ``checkpoint/ds_to_universal.py``: because
+this framework's checkpoints are universal by construction (full tensors per
+pytree path), "conversion" is re-keying, not merging —
+
+    python -m deepspeed_tpu.checkpoint_utils fp32   <ckpt_dir> <out.safetensors>
+    python -m deepspeed_tpu.checkpoint_utils hf-llama <ckpt_dir> <out_dir> \
+        --num-layers N   # tied/untied embeddings auto-detected
+
+``fp32`` writes a single consolidated fp32 model file;
+``hf-llama`` writes an HF-transformers-compatible LLaMA state dict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict
+
+import numpy as np
+
+
+def _load_model_tensors(ckpt_dir: str) -> Dict[str, np.ndarray]:
+    from .runtime.checkpoint.engine import _LATEST, _load_tree_flat
+
+    if os.path.exists(os.path.join(ckpt_dir, _LATEST)):
+        tag = open(os.path.join(ckpt_dir, _LATEST)).read().strip()
+        ckpt_dir = os.path.join(ckpt_dir, tag)
+    path = os.path.join(ckpt_dir, "model.safetensors")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no model.safetensors under {ckpt_dir}")
+    return _load_tree_flat(path)
+
+
+def to_fp32(ckpt_dir: str, out_path: str) -> None:
+    from safetensors.numpy import save_file
+
+    flat = _load_model_tensors(ckpt_dir)
+    fp32 = {k: np.asarray(v, np.float32) for k, v in flat.items()}
+    save_file(fp32, out_path)
+    total = sum(v.size for v in fp32.values())
+    print(f"wrote {out_path}: {len(fp32)} tensors, {total / 1e6:.1f}M params fp32")
+
+
+def to_hf_llama(ckpt_dir: str, out_dir: str, num_layers: int) -> None:
+    from safetensors.numpy import save_file
+
+    from .models import transformer as tfm
+    from .models.hf_integration import params_to_hf_llama
+
+    flat = _load_model_tensors(ckpt_dir)
+    # tied embeddings are a property of the checkpoint, not a flag: untied
+    # models carry an lm_head tensor
+    tie_embeddings = not any(k.startswith("lm_head") for k in flat)
+
+    # rebuild the nested tree from flat "a/b/c" keys
+    tree: Dict = {}
+    for key, v in flat.items():
+        node = tree
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = np.asarray(v)
+
+    cfg = tfm.TransformerConfig(num_layers=num_layers,
+                                tie_embeddings=tie_embeddings)
+    sd = params_to_hf_llama(tree, cfg)
+    os.makedirs(out_dir, exist_ok=True)
+    out = os.path.join(out_dir, "model.safetensors")
+    save_file({k: np.ascontiguousarray(v) for k, v in sd.items()}, out)
+    print(f"wrote {out}: {len(sd)} tensors (HF LLaMA layout)")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="deepspeed_tpu.checkpoint_utils")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    f32 = sub.add_parser("fp32", help="consolidated fp32 safetensors")
+    f32.add_argument("ckpt_dir")
+    f32.add_argument("out_path")
+    hf = sub.add_parser("hf-llama", help="HF LLaMA state dict")
+    hf.add_argument("ckpt_dir")
+    hf.add_argument("out_dir")
+    hf.add_argument("--num-layers", type=int, required=True)
+    args = p.parse_args(argv)
+    if args.cmd == "fp32":
+        to_fp32(args.ckpt_dir, args.out_path)
+    else:
+        to_hf_llama(args.ckpt_dir, args.out_dir, args.num_layers)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -343,6 +343,12 @@ def _remat_policy(name: str):
         "dots_saveable": jax.checkpoint_policies.dots_saveable,
         "dots_with_no_batch_dims_saveable":
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # save only the (tagged) attention outputs: backward re-runs the cheap
+        # elementwise/matmul parts but never the O(S²)-FLOP attention kernel
+        "save_attn": jax.checkpoint_policies.save_only_these_names("attn_out"),
+        # additionally save the MLP output (more memory, less recompute)
+        "save_attn_mlp": jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "mlp_out"),
     }
     if name not in pols:
         raise ValueError(f"unknown remat policy {name!r}")
@@ -370,20 +376,25 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
     if cfg.position == "rope":
         cos, sin = rope_table(S, cfg.head_dim, cfg.rope_theta)
 
+    from jax.ad_checkpoint import checkpoint_name
+
     def layer_body(carry, layer_params):
         h = carry
         a_in = _norm(h, layer_params["ln1"], cfg.norm, cfg.norm_eps)
-        h = h + _attention_block(a_in, layer_params["attn"], cfg, cos, sin, attn_fn)
+        attn_out = _attention_block(a_in, layer_params["attn"], cfg, cos, sin,
+                                    attn_fn)
+        h = h + checkpoint_name(attn_out, "attn_out")
         m_in = _norm(h, layer_params["ln2"], cfg.norm, cfg.norm_eps)
         if cfg.num_experts > 0:
             if moe_fn is None:
                 from ..moe.layer import dense_moe_block
 
-                h = h + dense_moe_block(m_in, layer_params["moe"], cfg)
+                mlp_out = dense_moe_block(m_in, layer_params["moe"], cfg)
             else:
-                h = h + moe_fn(m_in, layer_params["moe"], cfg)
+                mlp_out = moe_fn(m_in, layer_params["moe"], cfg)
         else:
-            h = h + _mlp_block(m_in, layer_params["mlp"], cfg)
+            mlp_out = _mlp_block(m_in, layer_params["mlp"], cfg)
+        h = h + checkpoint_name(mlp_out, "mlp_out")
         return h, None
 
     policy = _remat_policy(cfg.remat_policy)

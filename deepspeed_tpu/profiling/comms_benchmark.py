@@ -47,7 +47,9 @@ def run_comms_benchmark(topo: MeshTopology, axis: str = "dp",
 
     for mb in sizes_mb:
         elems = int(mb * 2**20 / jnp.dtype(dtype).itemsize)
-        elems = max(n * 128, elems // (n * 128) * (n * 128))
+        # divisible by n (sharding), n*n (all_to_all reshape) and 128 (lanes)
+        quantum = n * n * 128
+        elems = max(quantum, elems // quantum * quantum)
         x = jnp.ones((elems,), dtype)
 
         ops = {

@@ -44,9 +44,12 @@ def get_policy(cfg: Optional[ActivationCheckpointingConfig] = None):
     if cfg.cpu_checkpointing:
         # offload every saveable residual to host memory (ZeRO-R CPU ckpt)
         try:
+            # names must match the model's checkpoint_name tags
+            # (models/transformer.py tags "attn_out"/"mlp_out"; "ckpt" is the
+            # generic tag from this module's checkpoint_name helper)
             return pols.save_and_offload_only_these_names(
                 names_which_can_be_saved=[],
-                names_which_can_be_offloaded=["ckpt"],
+                names_which_can_be_offloaded=["attn_out", "mlp_out", "ckpt"],
                 offload_src="device", offload_dst="pinned_host")
         except Exception:  # pragma: no cover - older jax
             logger.warning("host-offload remat unavailable; using recompute-all")

@@ -42,11 +42,13 @@ def select_topk_columns(grad: jax.Array, topk_ratio: float) -> jax.Array:
     return jnp.broadcast_to(keep, grad.shape)
 
 
-def zenflow_partition(grads: Any, topk_ratio: float) -> Tuple[Any, Any]:
-    """→ (hot, cold): hot = top-k columns (rest zeroed), cold = complement."""
+def zenflow_partition(grads: Any, topk_ratio: float, return_masks: bool = False):
+    """→ (hot, cold[, masks]): hot = top-k columns (rest zeroed), cold = rest."""
     masks = jax.tree.map(lambda g: select_topk_columns(g, topk_ratio), grads)
     hot = jax.tree.map(lambda g, m: g * m.astype(g.dtype), grads, masks)
     cold = jax.tree.map(lambda g, m: g * (~m).astype(g.dtype), grads, masks)
+    if return_masks:
+        return hot, cold, masks
     return hot, cold
 
 
@@ -71,11 +73,8 @@ class ZenFlowOptimizer:
         self._step = 0
 
         def hot_update(params, grads, opt_state):
-            masks = jax.tree.map(
-                lambda g: select_topk_columns(g, cfg.topk_ratio), grads)
-            hot = jax.tree.map(lambda g, m: g * m.astype(g.dtype), grads, masks)
-            cold = jax.tree.map(lambda g, m: g * (~m).astype(g.dtype),
-                                grads, masks)
+            hot, cold, masks = zenflow_partition(grads, cfg.topk_ratio,
+                                                 return_masks=True)
             updates, new_state = optimizer.update(hot, opt_state, params)
             # mask the UPDATES too: the shared momentum would otherwise keep
             # nudging cold columns every step from stale state, double-applying

@@ -52,11 +52,17 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     n = S // chunk_size
     scale = 1.0 / math.sqrt(D)
 
-    if offload_kv:
-        host = _host_sharding(k)
-        if host is not None:
-            k = jax.device_put(k, host)
-            v = jax.device_put(v, host)
+    if offload_kv and not isinstance(k, jax.core.Tracer):
+        # only committed arrays can be re-placed; under jit tracing the
+        # placement belongs to the enclosing program (use the engine's
+        # activation-checkpointing host-offload policy there instead)
+        try:
+            host = _host_sharding(k)
+            if host is not None:
+                k = jax.device_put(k, host)
+                v = jax.device_put(v, host)
+        except Exception:
+            pass  # backends without pinned_host: run with device-resident KV
 
     qc = q.reshape(B, n, chunk_size, H, D).swapaxes(0, 1)  # (n, B, c, H, D)
     kc = k.reshape(B, n, chunk_size, H, D).swapaxes(0, 1)

@@ -2,6 +2,7 @@
 
 import os
 
+import jax
 import numpy as np
 import pytest
 
@@ -76,3 +77,22 @@ def test_keep_n_latest(tmp_path, devices):
         engine.save_checkpoint(str(tmp_path))
     tags = [d for d in os.listdir(tmp_path) if d.startswith("global_step")]
     assert len(tags) == 2
+
+
+def test_zero_to_fp32_cli(tmp_path, devices):
+    """The zero_to_fp32 analogue: consolidated fp32 export from any ckpt."""
+    from deepspeed_tpu.checkpoint_utils import main as ck_main
+    from safetensors.numpy import load_file
+
+    engine = _make_engine(stage=3)  # sharded checkpoint source
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path / "ck"))
+    out = str(tmp_path / "consolidated.safetensors")
+    ck_main(["fp32", str(tmp_path / "ck"), out])
+    tensors = load_file(out)
+    n = sum(v.size for v in tensors.values())
+    expect = sum(l.size for l in jax.tree.leaves(engine.state.params))
+    assert n == expect
+    assert all(v.dtype == np.float32 for v in tensors.values())

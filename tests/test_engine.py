@@ -102,3 +102,20 @@ def test_tp_composes_with_zero(devices):
     # mlp weight sharded over tp on the mlp axis
     w = engine.state.params["layers"]["mlp"]["w_in"]
     assert not w.sharding.is_fully_replicated
+
+
+@pytest.mark.parametrize("policy", ["save_attn", "save_attn_mlp", "dots_saveable"])
+def test_remat_policies_gradient_equivalence(devices, policy):
+    """Named remat policies change memory/compute tradeoffs, never gradients."""
+    import jax
+    from deepspeed_tpu.models import transformer as tfm
+
+    cfg_a = tfm.get_config("tiny", dtype="float32", remat_policy="nothing_saveable")
+    cfg_b = tfm.get_config("tiny", dtype="float32", remat_policy=policy)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg_a)
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 256, (2, 16)).astype(np.int32)}
+    g_a = jax.grad(lambda p: tfm.loss_fn(p, batch, cfg_a)[0])(params)
+    g_b = jax.grad(lambda p: tfm.loss_fn(p, batch, cfg_b)[0])(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4), g_a, g_b)
