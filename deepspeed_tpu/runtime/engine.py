@@ -48,7 +48,9 @@ LossFn = Callable[..., Tuple[jax.Array, Dict[str, jax.Array]]]
 class ModelSpec:
     """What the engine needs from a model: pure functions + annotated params.
 
-    ``loss_fn(params, batch, rng) -> (loss, metrics_dict)`` must be jittable.
+    ``loss_fn(params, batch, rng) -> (loss, metrics_dict)`` must be jittable,
+    with MEAN semantics over the batch (loss and metrics are per-example
+    averages — the contract data-parallel reduction relies on).
     ``param_axes`` is the logical-axes pytree (may be a prefix tree / None).
     """
 
@@ -152,6 +154,22 @@ class TrainingEngine:
         if self.offload_enabled and self.fp16_enabled:
             raise ConfigError(
                 "fp16 + offload_optimizer is not supported; use bf16")
+        if config.zero_optimization.zero_quantized_gradients:
+            if self.offload_enabled:
+                raise ConfigError(
+                    "zero_quantized_gradients + offload_optimizer is not "
+                    "supported yet (the offloaded grad step has no compressed-"
+                    "reduction wiring)")
+            if stage >= 3:
+                raise ConfigError(
+                    "zero_quantized_gradients requires stage <= 2 (params must "
+                    "be replicated across the dp axes for the manual reduction)")
+            for ax in ("tp", "sp", "ep", "pp"):
+                if topo.size(ax) > 1:
+                    raise ConfigError(
+                        f"zero_quantized_gradients cannot combine with {ax} "
+                        "parallelism (model-internal collectives cannot nest "
+                        "inside the manual dp reduction)")
 
         # ---- state init (sharded at construction) ---------------------
         self.opt_shardings = None  # set inside _init_state
@@ -262,33 +280,73 @@ class TrainingEngine:
                 scaled_loss, has_aux=True)(params)
             return loss, metrics, grads
 
+        # validated in __init__: stage <= 2, no tp/sp/ep/pp, no offload
+        qgz = cfg.zero_optimization.zero_quantized_gradients
+
         def step_fn(state: EngineState, batch: Dict[str, jax.Array]):
             rng, step_rng = jax.random.split(state.rng)
 
-            # --- grad accumulation over the leading gas axis -----------
-            def accum(carry, mb):
-                grads_acc, metrics_acc = carry
-                _, metrics, grads = microbatch_grads(
-                    state.params, mb, step_rng, state.loss_scale)
-                grads = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
-                                     grads_acc, grads)
-                metrics_acc = jax.tree.map(lambda a, m: a + m.astype(jnp.float32),
-                                           metrics_acc, metrics)
-                return (grads, metrics_acc), None
-
-            zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
             # metrics pytree mirrors whatever the user's loss_fn returns
             one_mb = jax.tree.map(lambda x: x[0], batch)
             _, metrics_shape = jax.eval_shape(
                 lambda p, b: loss_fn(p, b, step_rng), state.params, one_mb)
             zero_metrics = jax.tree.map(
                 lambda s: jnp.zeros((), jnp.float32), metrics_shape)
-            if gas > 1:
-                (grads, msum), _ = jax.lax.scan(accum, (zero_grads, zero_metrics), batch)
+
+            def accumulate(params, batch):
+                zg = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                  params)
+
+                def acc(carry, mb):
+                    grads_acc, metrics_acc = carry
+                    _, metrics, grads = microbatch_grads(
+                        params, mb, step_rng, state.loss_scale)
+                    grads = jax.tree.map(
+                        lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
+                    metrics_acc = jax.tree.map(
+                        lambda a, m: a + m.astype(jnp.float32), metrics_acc,
+                        metrics)
+                    return (grads, metrics_acc), None
+
+                if gas > 1:
+                    (g, m), _ = jax.lax.scan(acc, (zg, zero_metrics), batch)
+                else:
+                    (g, m), _ = acc((zg, zero_metrics),
+                                    jax.tree.map(lambda x: x[0], batch))
+                return g, m
+
+            if qgz:
+                # ZeRO++ qgZ: explicit DP with int8-compressed gradient
+                # reduction (ops/quantizer.compressed_all_reduce) instead of
+                # XLA's exact psum — 4x less gradient traffic over DCN.
+                # Assumes MEAN-semantics loss/metrics (the ModelSpec contract):
+                # per-shard values are averaged across dp; sum-semantics
+                # outputs would be rescaled by 1/dp_world.
+                from jax import shard_map
+                from ..ops.quantizer import compressed_all_reduce
+
+                dp_axes = ("dp", "fsdp")
+                ws = float(self.topo.dp_world_size)
+
+                def local(params, batch):
+                    g, m = accumulate(params, batch)
+                    g = jax.tree.map(
+                        lambda t: compressed_all_reduce(t / ws, dp_axes)
+                        if t.ndim >= 1 else jax.lax.psum(t / ws, dp_axes), g)
+                    m = jax.tree.map(lambda t: jax.lax.psum(t / ws, dp_axes), m)
+                    return g, m
+
+                batch_specs = jax.tree.map(
+                    lambda _: P(None, ("dp", "fsdp")), batch)
+                grads, msum = shard_map(
+                    local, mesh=self.topo.mesh,
+                    in_specs=(jax.tree.map(lambda _: P(), state.params),
+                              batch_specs),
+                    out_specs=(jax.tree.map(lambda _: P(), state.params),
+                               jax.tree.map(lambda _: P(), zero_metrics)),
+                    check_vma=False)(state.params, batch)
             else:
-                one = jax.tree.map(lambda x: x[0], batch)
-                (grads, msum), _ = accum((zero_grads, zero_metrics), one)
+                grads, msum = accumulate(state.params, batch)
             metrics = jax.tree.map(lambda m: m / gas, msum)
 
             # --- unscale + average ------------------------------------
