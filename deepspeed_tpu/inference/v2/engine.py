@@ -154,72 +154,108 @@ def build_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
     Pallas kernel (ops/pallas/paged_attention.py) — the FastGen decode hot
     loop.  tokens/positions: (max_seqs,); context_lens INCLUDE the new token.
     """
+
+    def fwd(params, caches, token_ids, position_ids, block_tables, context_lens):
+        return _decode_body(params, caches, token_ids, position_ids,
+                            block_tables, context_lens, model_cfg, v2)
+
+    return jax.jit(fwd, donate_argnums=(1,))
+
+
+def build_multi_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config,
+                               num_steps: int):
+    """Greedy-decode ``num_steps`` tokens per sequence inside ONE jitted
+    program (an outer ``lax.scan`` over single-token decodes) — eliminates the
+    per-token host roundtrip that dominates small-model decode.  Safe because
+    admission reserves each sequence's whole block budget up front.
+
+    Returns (tokens_out (num_steps, max_seqs), caches)."""
+
+    def fwd(params, caches, token_ids, position_ids, block_tables, context_lens):
+        # rows inactive at entry must STAY inactive: advancing their ctx/pos
+        # would flip them "active" with a zeroed block table and corrupt
+        # block 0 of a real sequence
+        alive = (context_lens > 0).astype(jnp.int32)
+
+        def step(carry, _):
+            caches, tok, pos, ctx = carry
+            logits, caches = _decode_body(params, caches, tok, pos,
+                                          block_tables, ctx, model_cfg, v2)
+            nxt = logits.argmax(-1).astype(jnp.int32)
+            return (caches, nxt, pos + alive, ctx + alive), nxt
+
+        (caches, _, _, _), toks = jax.lax.scan(
+            step, (caches, token_ids, position_ids, context_lens), None,
+            length=num_steps)
+        return toks, caches
+
+    return jax.jit(fwd, donate_argnums=(1,))
+
+
+def _decode_body(params, caches, token_ids, position_ids, block_tables,
+                 context_lens, model_cfg, v2):
+    """Single-token decode shared by build_decode_forward and the multi-step
+    scan (context_lens INCLUDE the current token)."""
     from ...ops.pallas.paged_attention import paged_decode_attention
 
     dt = jnp.dtype(v2.dtype)
     bs = v2.block_size
+    S = token_ids.shape[0]
+    x = params["embed"]["tokens"].astype(dt)[token_ids]
+    if model_cfg.position == "learned":
+        x = x + params["embed"]["position"].astype(dt)[position_ids]
+    cos_full, sin_full = (None, None)
+    if model_cfg.position == "rope":
+        max_len = v2.max_blocks_per_seq * bs
+        cos_full, sin_full = tfm.rope_table(max_len, model_cfg.head_dim,
+                                            model_cfg.rope_theta)
+    active = context_lens > 0
+    blk_ids = jnp.where(
+        active,
+        block_tables[jnp.arange(S), position_ids // bs],
+        caches["k"].shape[1] - 1)
+    offsets = position_ids % bs
+    nh, nkv, hd = model_cfg.num_heads, model_cfg.kv_heads, model_cfg.head_dim
 
-    def fwd(params, caches, token_ids, position_ids, block_tables, context_lens):
-        S = token_ids.shape[0]
-        x = params["embed"]["tokens"].astype(dt)[token_ids]  # (S, H)
-        if model_cfg.position == "learned":
-            x = x + params["embed"]["position"].astype(dt)[position_ids]
-        cos_full, sin_full = (None, None)
+    def layer_body(x, inp):
+        lp, k_cache, v_cache = inp
+        a_in = tfm._norm(x, lp["ln1"], model_cfg.norm, model_cfg.norm_eps)
+        q = (a_in @ lp["attn"]["wq"].astype(dt)).reshape(S, nh, hd)
+        k = (a_in @ lp["attn"]["wk"].astype(dt)).reshape(S, nkv, hd)
+        v = (a_in @ lp["attn"]["wv"].astype(dt)).reshape(S, nkv, hd)
         if model_cfg.position == "rope":
-            max_len = v2.max_blocks_per_seq * bs
-            cos_full, sin_full = tfm.rope_table(max_len, model_cfg.head_dim,
-                                                model_cfg.rope_theta)
+            cos = cos_full[position_ids][:, None, :].astype(dt)
+            sin = sin_full[position_ids][:, None, :].astype(dt)
 
-        # rows beyond the active sequences write to the scratch block
-        active = context_lens > 0
-        blk_ids = jnp.where(
-            active,
-            block_tables[jnp.arange(S), position_ids // bs],
-            caches["k"].shape[1] - 1)
-        offsets = position_ids % bs
-        nh, nkv, hd = model_cfg.num_heads, model_cfg.kv_heads, model_cfg.head_dim
+            def rot(t):
+                t1, t2 = t[..., ::2], t[..., 1::2]
+                o1 = t1 * cos - t2 * sin
+                o2 = t2 * cos + t1 * sin
+                return jnp.stack([o1, o2], axis=-1).reshape(t.shape)
 
-        def layer_body(x, inp):
-            lp, k_cache, v_cache = inp
-            a_in = tfm._norm(x, lp["ln1"], model_cfg.norm, model_cfg.norm_eps)
-            q = (a_in @ lp["attn"]["wq"].astype(dt)).reshape(S, nh, hd)
-            k = (a_in @ lp["attn"]["wk"].astype(dt)).reshape(S, nkv, hd)
-            v = (a_in @ lp["attn"]["wv"].astype(dt)).reshape(S, nkv, hd)
-            if model_cfg.position == "rope":
-                cos = cos_full[position_ids][:, None, :].astype(dt)  # (S,1,hd/2)
-                sin = sin_full[position_ids][:, None, :].astype(dt)
-                # inline rope on (S, heads, d): same pairing as apply_rope
-                def rot(t):
-                    t1, t2 = t[..., ::2], t[..., 1::2]
-                    o1 = t1 * cos - t2 * sin
-                    o2 = t2 * cos + t1 * sin
-                    return jnp.stack([o1, o2], axis=-1).reshape(t.shape)
+            q, k = rot(q), rot(k)
+        k_cache = k_cache.at[blk_ids, offsets].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[blk_ids, offsets].set(v.astype(v_cache.dtype))
+        o = paged_decode_attention(q, k_cache, v_cache, block_tables,
+                                   context_lens)
+        x = x + o.reshape(S, nh * hd) @ lp["attn"]["wo"].astype(dt)
+        m_in = tfm._norm(x, lp["ln2"], model_cfg.norm, model_cfg.norm_eps)
+        if model_cfg.num_experts > 0:
+            from ...moe.layer import dense_moe_block
 
-                q, k = rot(q), rot(k)
-            k_cache = k_cache.at[blk_ids, offsets].set(k.astype(k_cache.dtype))
-            v_cache = v_cache.at[blk_ids, offsets].set(v.astype(v_cache.dtype))
-            o = paged_decode_attention(q, k_cache, v_cache, block_tables,
-                                       context_lens)
-            x = x + o.reshape(S, nh * hd) @ lp["attn"]["wo"].astype(dt)
-            m_in = tfm._norm(x, lp["ln2"], model_cfg.norm, model_cfg.norm_eps)
-            if model_cfg.num_experts > 0:
-                from ...moe.layer import dense_moe_block
-
-                x = x + dense_moe_block(m_in[None], lp["moe"], model_cfg)[0]
-            else:
-                x = x + tfm._mlp_block(m_in[None], lp["mlp"], model_cfg)[0]
-            return x, (k_cache, v_cache)
-
-        x, (new_k, new_v) = jax.lax.scan(
-            layer_body, x, (params["layers"], caches["k"], caches["v"]))
-        x = tfm._norm(x, params["final_norm"], model_cfg.norm, model_cfg.norm_eps)
-        if model_cfg.tie_embeddings:
-            logits = x @ params["embed"]["tokens"].astype(dt).T
+            x = x + dense_moe_block(m_in[None], lp["moe"], model_cfg)[0]
         else:
-            logits = x @ params["lm_head"]["w"].astype(dt)
-        return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+            x = x + tfm._mlp_block(m_in[None], lp["mlp"], model_cfg)[0]
+        return x, (k_cache, v_cache)
 
-    return jax.jit(fwd, donate_argnums=(1,))
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_body, x, (params["layers"], caches["k"], caches["v"]))
+    x = tfm._norm(x, params["final_norm"], model_cfg.norm, model_cfg.norm_eps)
+    if model_cfg.tie_embeddings:
+        logits = x @ params["embed"]["tokens"].astype(dt).T
+    else:
+        logits = x @ params["lm_head"]["w"].astype(dt)
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
 # ---------------------------------------------------------------------------
@@ -249,6 +285,7 @@ class InferenceEngineV2:
         self.caches = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
         self._fwd = build_ragged_forward(self.model_cfg, self.cfg)
         self._decode_fwd = build_decode_forward(self.model_cfg, self.cfg)
+        self._multi_decode = {}  # num_steps -> jitted burst decoder
         self.running: Dict[int, SequenceDescriptor] = {}
         self.waiting: Deque[SequenceDescriptor] = deque()
         self._uid = 0
@@ -313,15 +350,9 @@ class InferenceEngineV2:
         pure_decode = all(n == 1 and s.seen_tokens > 0 for s, n in picks)
         if pure_decode:
             # hot path: one token per sequence through the paged Pallas kernel
-            batch = self.builder.build(picks)
-            ns = len(picks)
-            tok = np.zeros(self.cfg.max_seqs, np.int32)
-            pos = np.zeros(self.cfg.max_seqs, np.int32)
-            tok[:ns] = batch.token_ids[:ns]
-            pos[:ns] = batch.position_ids[:ns]
+            tok, pos, bt, ctx = self._decode_inputs(picks)
             logits, self.caches = self._decode_fwd(
-                self.params, self.caches, jnp.asarray(tok), jnp.asarray(pos),
-                jnp.asarray(batch.block_tables), jnp.asarray(batch.context_lens))
+                self.params, self.caches, tok, pos, bt, ctx)
         else:
             batch = self.builder.build(picks)
             logits, self.caches = self._fwd(
@@ -351,15 +382,67 @@ class InferenceEngineV2:
                     del self.running[seq.uid]
         return out
 
+    def _decode_inputs(self, picks):
+        """Padded (tok, pos, block_tables, context_lens) for pure-decode
+        dispatch — shared by step() and _burst_decode."""
+        batch = self.builder.build(picks)
+        ns = len(picks)
+        tok = np.zeros(self.cfg.max_seqs, np.int32)
+        pos = np.zeros(self.cfg.max_seqs, np.int32)
+        tok[:ns] = batch.token_ids[:ns]
+        pos[:ns] = batch.position_ids[:ns]
+        return (jnp.asarray(tok), jnp.asarray(pos),
+                jnp.asarray(batch.block_tables),
+                jnp.asarray(batch.context_lens))
+
+    def _burst_decode(self, k: int) -> None:
+        """Greedy-decode ``k`` tokens for every running sequence in one jitted
+        program (multi-token decode; host loop eliminated)."""
+        picks = [(s, 1) for s in self.running.values()]
+        for s, _ in picks:  # blocks were reserved at admission
+            if not self.kv.ensure_capacity(s, k):
+                raise RuntimeError(
+                    "burst decode capacity invariant violated: admission must "
+                    "reserve the full block budget")
+        if k not in self._multi_decode:
+            self._multi_decode[k] = build_multi_decode_forward(
+                self.model_cfg, self.cfg, k)
+        tok, pos, bt, ctx = self._decode_inputs(picks)
+        toks, self.caches = self._multi_decode[k](
+            self.params, self.caches, tok, pos, bt, ctx)
+        toks = np.asarray(toks)  # (k, max_seqs)
+        for row, (seq, _) in enumerate(picks):
+            new = toks[:, row].tolist()
+            seq.seen_tokens += k
+            seq.tokens.extend(new)
+            seq.generated += k
+            if seq.generated >= seq.max_new_tokens:
+                seq.done = True
+                self.kv.release(seq)
+                del self.running[seq.uid]
+
     def generate_all(self, temperature: float = 0.0, seed: int = 0,
-                     max_steps: int = 10000) -> Dict[int, List[int]]:
-        """Drive until every queued request completes."""
+                     max_steps: int = 10000, burst: int = 8
+                     ) -> Dict[int, List[int]]:
+        """Drive until every queued request completes.  Greedy decode uses
+        ``burst``-token in-graph bursts when every running sequence is in
+        decode with enough budget."""
         results: Dict[int, List[int]] = {}
         tracked = {s.uid: s for s in list(self.waiting)} | dict(self.running)
         rng = jax.random.PRNGKey(seed)
         for _ in range(max_steps):
             if not self.waiting and not self.running:
                 break
+            decode_ready = (not self.waiting and self.running and
+                            all(s.seen_tokens >= s.cur_len - 1 and
+                                s.seen_tokens > 0
+                                for s in self.running.values()))
+            budget = min((s.max_new_tokens - s.generated
+                          for s in self.running.values()), default=0)
+            if temperature == 0.0 and decode_ready and burst > 1 and                     budget >= burst and                     all(s.seen_tokens == s.cur_len - 1
+                        for s in self.running.values()):
+                self._burst_decode(burst)
+                continue
             rng, step_rng = jax.random.split(rng)
             self.step(temperature=temperature, rng=step_rng)
         for uid, seq in tracked.items():

@@ -153,3 +153,22 @@ def test_v2_no_livelock_on_small_pool(devices, tiny_model):
     results = eng.generate_all(max_steps=200)
     for uid in uids:
         assert len(results[uid]) == 4 + 8, results[uid]
+
+
+def test_burst_decode_matches_single_step(devices, tiny_model):
+    """Multi-token in-graph decode must produce exactly the single-step tokens."""
+    cfg, params = tiny_model
+    mk = lambda: InferenceEngineV2(cfg, params, V2Config(
+        max_tokens_per_step=32, max_seqs=4, block_size=8, num_blocks=64,
+        max_blocks_per_seq=8, dtype="float32"))
+    prompts = [[5, 6, 7], [9, 8]]
+
+    e1 = mk()
+    uids1 = [e1.put(p, max_new_tokens=12) for p in prompts]
+    r1 = e1.generate_all(burst=4)  # burst path
+
+    e2 = mk()
+    uids2 = [e2.put(p, max_new_tokens=12) for p in prompts]
+    r2 = e2.generate_all(burst=1)  # pure single-step path
+    for u1, u2 in zip(uids1, uids2):
+        assert r1[u1] == r2[u2], (r1[u1], r2[u2])
