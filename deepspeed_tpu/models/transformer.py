@@ -45,6 +45,8 @@ class TransformerConfig:
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
+    # sliding-window attention (0 == full); Mistral-style band
+    sliding_window: int = 0
     # MoE (0 == dense); see deepspeed_tpu/moe for the layer implementation
     num_experts: int = 0
     moe_top_k: int = 2
@@ -104,6 +106,9 @@ PRESETS: Dict[str, Dict[str, Any]] = {
     "mixtral-8x7b": dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
                          num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=32768,
                          num_experts=8, moe_top_k=2),
+    "mistral-7b": dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                       num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=32768,
+                       sliding_window=4096, attn_impl="flash"),
     "tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
                  num_heads=4, max_seq_len=128),
     "tiny-moe": dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
@@ -367,6 +372,11 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
     dt = jnp.dtype(cfg.dtype)
     if attn_fn is None:
         attn_fn = resolve_attention(cfg.attn_impl)
+        if cfg.sliding_window > 0:
+            if cfg.attn_impl != "flash":
+                raise ValueError(
+                    "sliding_window requires attn_impl='flash'")
+            attn_fn = partial(attn_fn, window=cfg.sliding_window)
     B, S = tokens.shape
 
     x = params["embed"]["tokens"].astype(dt)[tokens]

@@ -37,6 +37,28 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def _band_mask(s_shape, q_start, k_start, causal: bool, window: int):
+    """Causal/sliding-window keep-mask for one (bq, bk) tile.  ``window > 0``
+    keeps keys in (query-window, query] — the band implies the causal upper
+    bound even when ``causal=False``."""
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s_shape, 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s_shape, 1)
+    if window > 0:
+        return (cols > rows - window) & (cols <= rows)
+    return rows >= cols
+
+
+def _tile_in_band(q_start, k_start, block_q: int, block_k: int,
+                  causal: bool, window: int):
+    """Static predicate: does this tile intersect the kept band?"""
+    ok = True
+    if causal or window > 0:
+        ok = q_start + block_q - 1 >= k_start
+    if window > 0:
+        ok = ok & (k_start + block_k - 1 >= q_start - window + 1)
+    return ok
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -45,7 +67,8 @@ def _interpret() -> bool:
 def _fwd_kernel(q_ref, k_ref, v_ref,  # inputs
                 o_ref, lse_ref,  # outputs
                 acc_ref, m_ref, l_ref,  # scratch
-                *, sm_scale: float, causal: bool, block_q: int, block_k: int):
+                *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+                window: int):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -58,10 +81,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref,  # inputs
     q_start = iq * block_q
     k_start = ik * block_k
 
-    should_run = True
-    if causal:
-        # skip blocks strictly above the diagonal
-        should_run = q_start + block_q - 1 >= k_start
+    should_run = _tile_in_band(q_start, k_start, block_q, block_k, causal, window)
 
     @pl.when(should_run)
     def _compute():
@@ -73,10 +93,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref,  # inputs
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale  # (bq, bk)
 
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        if causal or window > 0:
+            s = jnp.where(_band_mask(s.shape, q_start, k_start, causal, window),
+                          s, DEFAULT_MASK_VALUE)
 
         m_prev = m_ref[:]  # (bq, 1)
         l_prev = l_ref[:]
@@ -100,7 +119,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref,  # inputs
         lse_ref[0, 0] = jnp.where(l == 0.0, -jnp.inf, lse)
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, window=0
                ) -> Tuple[jax.Array, jax.Array]:
     B, H, S, D = q.shape
     KV = k.shape[1]
@@ -112,7 +131,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k
     grid = (B, H, nq, nk)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -149,7 +168,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dk_ref, dv_ref,
                      dk_acc, dv_acc,
-                     *, sm_scale, causal, block_q, block_k, nq: int):
+                     *, sm_scale, causal, block_q, block_k, nq: int,
+                     window: int = 0):
     # grid: (B, KV, nk, group*nq) — the innermost dim walks every q block of
     # every query head in this kv head's group, accumulating straight into
     # the per-KV-head dk/dv (no (B, H, S, D) f32 intermediate).
@@ -164,9 +184,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q_start = iq * block_q
     k_start = ik * block_k
-    should_run = True
-    if causal:
-        should_run = q_start + block_q - 1 >= k_start
+    should_run = _tile_in_band(q_start, k_start, block_q, block_k, causal, window)
 
     @pl.when(should_run)
     def _compute():
@@ -179,10 +197,9 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        if causal or window > 0:
+            s = jnp.where(_band_mask(s.shape, q_start, k_start, causal, window),
+                          s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)  # (bq, bk)
 
         dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -201,7 +218,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc,
-                   *, sm_scale, causal, block_q, block_k):
+                   *, sm_scale, causal, block_q, block_k,
+                   window: int = 0):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -211,9 +229,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q_start = iq * block_q
     k_start = ik * block_k
-    should_run = True
-    if causal:
-        should_run = q_start + block_q - 1 >= k_start
+    should_run = _tile_in_band(q_start, k_start, block_q, block_k, causal, window)
 
     @pl.when(should_run)
     def _compute():
@@ -226,10 +242,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        if causal or window > 0:
+            s = jnp.where(_band_mask(s.shape, q_start, k_start, causal, window),
+                          s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -242,7 +257,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
+def _flash_bwd(sm_scale, causal, block_q, block_k, window, res, g):
     q, k, v, out, lse = res
     B, H, S, D = q.shape
     KV = k.shape[1]
@@ -259,7 +274,8 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
     # (B, KV, Skv, D) result — no (B, H, Skv, D) f32 intermediate.
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nq=nq),
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          window=window),
         grid=(B, KV, nk, group * nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D),
@@ -298,7 +314,7 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, window=window),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -327,32 +343,35 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_bhsd(q, k, v, sm_scale, causal, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhsd(q, k, v, sm_scale, causal, block_q, block_k, window):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, window)
     return out
 
 
-def _fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k)
+def _fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, window):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, window)
     return out, (q, k, v, out, lse)
 
 
 _flash_attention_bhsd.defvjp(
     _fwd_rule,
-    lambda sm_scale, causal, block_q, block_k, res, g: _flash_bwd(
-        sm_scale, causal, block_q, block_k, res, g))
+    lambda sm_scale, causal, block_q, block_k, window, res, g: _flash_bwd(
+        sm_scale, causal, block_q, block_k, window, res, g))
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, sm_scale: Optional[float] = None,
                     block_q: int = 512, block_k: int = 512,
-                    segment_ids=None) -> jax.Array:
+                    segment_ids=None, window: int = 0) -> jax.Array:
     """Fused attention. q: (B, S, H, D); k/v: (B, S, KV, D) with KV | H.
 
-    Differentiable (custom VJP); supports causal masking and GQA. Falls back
-    to the XLA einsum path when shapes don't fit the kernel constraints
-    (segment_ids, tiny/unaligned sequence lengths).
+    Differentiable (custom VJP); supports causal masking, GQA and sliding-
+    window (``window`` > 0 keeps keys in (query-window, query] — the
+    Mistral-style band and the practical block-sparse-attention pattern:
+    out-of-band tiles are skipped entirely). Falls back to the XLA einsum
+    path when shapes don't fit the kernel constraints (segment_ids,
+    tiny/unaligned sequence lengths).
     """
     B, S, H, D = q.shape
     KV = k.shape[2]
@@ -363,16 +382,23 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     block_k = min(block_k, k.shape[1])
     usable = (segment_ids is None and S % block_q == 0
               and k.shape[1] % block_k == 0 and H % KV == 0)
+    if segment_ids is not None and window > 0:
+        raise NotImplementedError(
+            "segment_ids + sliding window is not supported yet")
     if not usable:
         from ...models.transformer import xla_attention
 
+        if window > 0:
+            return _windowed_reference(q, k, v, causal, window,
+                                       sm_scale=sm_scale)
         return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
 
     # kernel layout is (B, H, S, D)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_attention_bhsd(qt, kt, vt, sm_scale, causal, block_q, block_k)
+    out = _flash_attention_bhsd(qt, kt, vt, sm_scale, causal, block_q, block_k,
+                                window)
     return out.transpose(0, 2, 1, 3)
 
 
@@ -381,3 +407,27 @@ def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None
     from ...models.transformer import xla_attention
 
     return xla_attention(q, k, v, causal=causal)
+
+
+def _windowed_reference(q, k, v, causal: bool, window: int,
+                        sm_scale: Optional[float] = None):
+    """XLA reference with the sliding-window band mask: keys in
+    (query-window, query] (the band implies the causal upper bound)."""
+    import math as _math
+
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = sm_scale if sm_scale is not None else 1.0 / _math.sqrt(D)
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    rows = jnp.arange(S)[:, None]
+    cols = jnp.arange(S)[None, :]
+    keep = (cols > rows - window) & (cols <= rows)
+    logits = jnp.where(keep[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)

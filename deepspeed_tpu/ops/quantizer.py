@@ -69,6 +69,27 @@ def dequantize_blockwise(codes: jax.Array, scales: jax.Array, bits: int = 8,
     return out.astype(dtype)
 
 
+def quantize_fp8(x: jax.Array, block_size: int = 256,
+                 fp8_dtype=jnp.float8_e4m3fn) -> Tuple[jax.Array, jax.Array]:
+    """Block-scaled fp8 quantization (reference: ``csrc/fp_quantizer``
+    FP8/FP6 path).  Scales map each block's absmax to the fp8 max (448 for
+    e4m3), preserving dynamic range per block."""
+    blocks, _ = _block_reshape(x.astype(jnp.float32), block_size)
+    fp8_max = float(jnp.finfo(fp8_dtype).max)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / fp8_max
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    codes = (blocks / scale).astype(fp8_dtype)
+    return codes, scale[:, 0]
+
+
+def dequantize_fp8(codes: jax.Array, scales: jax.Array, shape=None,
+                   dtype=jnp.float32) -> jax.Array:
+    # fp8 codes scale-multiply exactly like int8 blocks after the cast
+    return dequantize_blockwise(codes.astype(jnp.float32), scales, bits=8,
+                                block_size=codes.shape[1], shape=shape,
+                                dtype=dtype)
+
+
 def quantization_error(x: jax.Array, bits: int = 8, block_size: int = 256) -> jax.Array:
     codes, scales = quantize_blockwise(x, bits, block_size)
     y = dequantize_blockwise(codes, scales, bits, block_size, shape=x.shape,

@@ -106,3 +106,15 @@ def test_fused_adamw_weight_decay():
     p1, _ = fused_adamw_tree(params, grads, state, lr=0.1, weight_decay=0.1)
     # zero grad, wd pulls toward zero: p = 1 - lr*wd*1
     np.testing.assert_allclose(np.asarray(p1["w"]), 0.99, rtol=1e-5)
+
+
+def test_fp8_roundtrip():
+    from deepspeed_tpu.ops.quantizer import dequantize_fp8, quantize_fp8
+
+    x = jax.random.normal(jax.random.PRNGKey(7), (1000,)) * 3.0
+    codes, scales = quantize_fp8(x, block_size=128)
+    assert codes.dtype == jnp.float8_e4m3fn
+    y = dequantize_fp8(codes, scales, shape=x.shape)
+    # e4m3 has ~2 decimal digits: relative error per element < 2^-3 of absmax
+    rel = float(jnp.abs(y - x).max() / jnp.abs(x).max())
+    assert rel < 0.07, rel
