@@ -172,3 +172,46 @@ def test_burst_decode_matches_single_step(devices, tiny_model):
     r2 = e2.generate_all(burst=1)  # pure single-step path
     for u1, u2 in zip(uids1, uids2):
         assert r1[u1] == r2[u2], (r1[u1], r2[u2])
+
+
+def test_scheduler_fuzz_block_ownership(devices, tiny_model):
+    """Property test: under random arrivals/lengths, (1) no KV block is ever
+    owned by two live sequences, (2) every request completes exactly, and
+    (3) the pool is fully recycled."""
+    cfg, params = tiny_model
+    rng = np.random.default_rng(42)
+    eng = InferenceEngineV2(cfg, params, V2Config(
+        max_tokens_per_step=24, max_seqs=3, block_size=4, num_blocks=40,
+        max_blocks_per_seq=8, dtype="float32"))
+    free0 = eng.kv.allocator.free_blocks
+    pending = []
+    for _ in range(12):
+        plen = int(rng.integers(1, 10))
+        mnew = int(rng.integers(1, 12))
+        prompt = rng.integers(1, 256, plen).tolist()
+        pending.append((prompt, mnew))
+    submitted = {}  # uid -> (descriptor, prompt, max_new)
+    steps = 0
+    while (pending or eng.waiting or eng.running) and steps < 500:
+        # random arrival
+        if pending and rng.random() < 0.4:
+            prompt, mnew = pending.pop()
+            uid = eng.put(prompt, max_new_tokens=mnew)
+            desc = eng.waiting[-1]
+            submitted[uid] = (desc, prompt, mnew)
+        eng.step()
+        steps += 1
+        # invariant: no block owned twice among live sequences
+        owned = []
+        for s in list(eng.running.values()) + list(eng.waiting):
+            owned.extend(s.blocks)
+        assert len(owned) == len(set(owned)), "block double-ownership!"
+    assert not pending and not eng.running and not eng.waiting, "stalled"
+    assert eng.kv.allocator.free_blocks == free0, "block leak"
+    # every request completed with exactly prompt + max_new tokens
+    assert len(submitted) == 12
+    for uid, (desc, prompt, mnew) in submitted.items():
+        assert desc.done
+        assert len(desc.tokens) == len(prompt) + mnew, \
+            (uid, len(desc.tokens), len(prompt), mnew)
+        assert desc.tokens[:len(prompt)] == prompt
