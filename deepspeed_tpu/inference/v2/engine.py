@@ -164,28 +164,40 @@ def build_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
 
 def build_multi_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config,
                                num_steps: int):
-    """Greedy-decode ``num_steps`` tokens per sequence inside ONE jitted
-    program (an outer ``lax.scan`` over single-token decodes) — eliminates the
-    per-token host roundtrip that dominates small-model decode.  Safe because
-    admission reserves each sequence's whole block budget up front.
+    """Decode ``num_steps`` tokens per sequence inside ONE jitted program (an
+    outer ``lax.scan`` over single-token decodes) — eliminates the per-token
+    host roundtrip that dominates small-model decode.  Safe because admission
+    reserves each sequence's whole block budget up front.
+
+    ``temperature == 0`` → greedy argmax; ``> 0`` → categorical sampling with
+    a per-step split of ``rng`` (carried through the scan).
 
     Returns (tokens_out (num_steps, max_seqs), caches)."""
 
-    def fwd(params, caches, token_ids, position_ids, block_tables, context_lens):
+    def fwd(params, caches, token_ids, position_ids, block_tables, context_lens,
+            rng, temperature):
         # rows inactive at entry must STAY inactive: advancing their ctx/pos
         # would flip them "active" with a zeroed block table and corrupt
         # block 0 of a real sequence
         alive = (context_lens > 0).astype(jnp.int32)
 
         def step(carry, _):
-            caches, tok, pos, ctx = carry
+            caches, tok, pos, ctx, rng = carry
             logits, caches = _decode_body(params, caches, tok, pos,
                                           block_tables, ctx, model_cfg, v2)
-            nxt = logits.argmax(-1).astype(jnp.int32)
-            return (caches, nxt, pos + alive, ctx + alive), nxt
+            rng, step_rng = jax.random.split(rng)
+            # lax.cond: the greedy branch skips Gumbel sampling entirely
+            nxt = jax.lax.cond(
+                temperature > 0.0,
+                lambda l: jax.random.categorical(
+                    step_rng, l / jnp.maximum(temperature, 1e-6)
+                ).astype(jnp.int32),
+                lambda l: l.argmax(-1).astype(jnp.int32),
+                logits)
+            return (caches, nxt, pos + alive, ctx + alive, rng), nxt
 
-        (caches, _, _, _), toks = jax.lax.scan(
-            step, (caches, token_ids, position_ids, context_lens), None,
+        (caches, _, _, _, _), toks = jax.lax.scan(
+            step, (caches, token_ids, position_ids, context_lens, rng), None,
             length=num_steps)
         return toks, caches
 
@@ -395,8 +407,9 @@ class InferenceEngineV2:
                 jnp.asarray(batch.block_tables),
                 jnp.asarray(batch.context_lens))
 
-    def _burst_decode(self, k: int) -> None:
-        """Greedy-decode ``k`` tokens for every running sequence in one jitted
+    def _burst_decode(self, k: int, temperature: float = 0.0,
+                      rng: Optional[jax.Array] = None) -> None:
+        """Decode ``k`` tokens for every running sequence in one jitted
         program (multi-token decode; host loop eliminated)."""
         picks = [(s, 1) for s in self.running.values()]
         for s, _ in picks:  # blocks were reserved at admission
@@ -408,8 +421,11 @@ class InferenceEngineV2:
             self._multi_decode[k] = build_multi_decode_forward(
                 self.model_cfg, self.cfg, k)
         tok, pos, bt, ctx = self._decode_inputs(picks)
+        if rng is None:
+            self._rng, rng = jax.random.split(self._rng)
         toks, self.caches = self._multi_decode[k](
-            self.params, self.caches, tok, pos, bt, ctx)
+            self.params, self.caches, tok, pos, bt, ctx, rng,
+            jnp.asarray(temperature, jnp.float32))
         toks = np.asarray(toks)  # (k, max_seqs)
         for row, (seq, _) in enumerate(picks):
             new = toks[:, row].tolist()
@@ -433,15 +449,17 @@ class InferenceEngineV2:
         for _ in range(max_steps):
             if not self.waiting and not self.running:
                 break
-            decode_ready = (not self.waiting and self.running and
-                            all(s.seen_tokens >= s.cur_len - 1 and
-                                s.seen_tokens > 0
-                                for s in self.running.values()))
-            budget = min((s.max_new_tokens - s.generated
-                          for s in self.running.values()), default=0)
-            if temperature == 0.0 and decode_ready and burst > 1 and                     budget >= burst and                     all(s.seen_tokens == s.cur_len - 1
-                        for s in self.running.values()):
-                self._burst_decode(burst)
+            can_burst = (
+                burst > 1
+                and not self.waiting and self.running
+                and all(s.seen_tokens == s.cur_len - 1 and s.seen_tokens > 0
+                        for s in self.running.values())
+                and min(s.max_new_tokens - s.generated
+                        for s in self.running.values()) >= burst)
+            if can_burst:
+                rng, burst_rng = jax.random.split(rng)
+                self._burst_decode(burst, temperature=temperature,
+                                   rng=burst_rng)
                 continue
             rng, step_rng = jax.random.split(rng)
             self.step(temperature=temperature, rng=step_rng)

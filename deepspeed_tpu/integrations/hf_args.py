@@ -1,0 +1,158 @@
+"""HuggingFace Trainer integration.
+
+Capability analogue of the reference's HF-Trainer contract
+(``transformers.integrations.deepspeed.HfTrainerDeepSpeedConfig`` — the
+reference side is ``"auto"`` values in the DS JSON that the Trainer resolves
+from its ``TrainingArguments``; SURVEY §5 "config system").  Two entry
+points:
+
+* ``resolve_auto_config(ds_config, args)`` — fill every ``"auto"`` in a
+  user's DeepSpeed-style JSON from TrainingArguments, exactly the fields the
+  reference resolves (batch sizes, optimizer lr/betas/eps/weight-decay,
+  scheduler warmup/total steps, clipping, fp16/bf16);
+* ``config_from_training_args(args)`` — build a complete framework config
+  from TrainingArguments alone (no JSON).
+
+``args`` may be a ``transformers.TrainingArguments`` or any object/dict with
+the same field names, so the shim has no hard transformers dependency.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Union
+
+from ..runtime.config_utils import is_auto
+
+
+def _get(args: Any, name: str, default=None):
+    if isinstance(args, dict):
+        return args.get(name, default)
+    return getattr(args, name, default)
+
+
+def _warmup_steps(args: Any, total_steps: int) -> int:
+    ws = _get(args, "warmup_steps", 0) or 0
+    if ws:
+        return int(ws)
+    ratio = _get(args, "warmup_ratio", 0.0) or 0.0
+    return int(total_steps * ratio)
+
+
+def _scheduler_from_args(args: Any, lr: float, total_steps: int) -> Dict[str, Any]:
+    kind = str(_get(args, "lr_scheduler_type", "linear"))
+    kind = kind.split(".")[-1].lower()  # enum → name
+    warm = _warmup_steps(args, total_steps)
+    if "cosine" in kind:
+        return {"type": "WarmupCosineLR",
+                "params": {"total_num_steps": total_steps,
+                           "warmup_num_steps": warm,
+                           "warmup_max_lr": lr}}
+    if "constant" in kind:
+        return {"type": "WarmupLR",
+                "params": {"warmup_num_steps": max(warm, 1),
+                           "warmup_max_lr": lr, "warmup_min_lr": 0.0}}
+    # linear (HF default)
+    return {"type": "WarmupDecayLR",
+            "params": {"total_num_steps": total_steps,
+                       "warmup_num_steps": warm,
+                       "warmup_max_lr": lr, "warmup_type": "linear"}}
+
+
+def config_from_training_args(args: Any, total_steps: Optional[int] = None,
+                              zero_stage: int = 2) -> Dict[str, Any]:
+    """TrainingArguments → a complete framework config dict."""
+    lr = float(_get(args, "learning_rate", 5e-5))
+    total = int(total_steps or _get(args, "max_steps", 0) or 10000)
+    cfg: Dict[str, Any] = {
+        "train_micro_batch_size_per_gpu": int(
+            _get(args, "per_device_train_batch_size", 8)),
+        "gradient_accumulation_steps": int(
+            _get(args, "gradient_accumulation_steps", 1)),
+        "gradient_clipping": float(_get(args, "max_grad_norm", 1.0) or 0.0),
+        "optimizer": {"type": "AdamW", "params": {
+            "lr": lr,
+            "betas": (float(_get(args, "adam_beta1", 0.9)),
+                      float(_get(args, "adam_beta2", 0.999))),
+            "eps": float(_get(args, "adam_epsilon", 1e-8)),
+            "weight_decay": float(_get(args, "weight_decay", 0.0)),
+        }},
+        "scheduler": _scheduler_from_args(args, lr, total),
+        "zero_optimization": {"stage": zero_stage},
+        "bf16": {"enabled": bool(_get(args, "bf16", False))},
+        "fp16": {"enabled": bool(_get(args, "fp16", False))},
+        "steps_per_print": int(_get(args, "logging_steps", 10) or 10),
+        "seed": int(_get(args, "seed", 42)),
+    }
+    return cfg
+
+
+# the "auto" fields the reference Trainer resolves, mapped to their source
+_AUTO_SOURCES = {
+    ("train_micro_batch_size_per_gpu",): "per_device_train_batch_size",
+    ("gradient_accumulation_steps",): "gradient_accumulation_steps",
+    ("gradient_clipping",): "max_grad_norm",
+    ("optimizer", "params", "lr"): "learning_rate",
+    ("optimizer", "params", "weight_decay"): "weight_decay",
+    ("optimizer", "params", "eps"): "adam_epsilon",
+    ("scheduler", "params", "warmup_max_lr"): "learning_rate",
+    ("scheduler", "params", "warmup_min_lr"): None,  # reference fills 0
+    ("bf16", "enabled"): "bf16",
+    ("fp16", "enabled"): "fp16",
+}
+
+
+def resolve_auto_config(ds_config: Dict[str, Any], args: Any,
+                        total_steps: Optional[int] = None) -> Dict[str, Any]:
+    """Fill ``"auto"`` values in a DeepSpeed-style JSON from TrainingArguments
+    (reference: HfTrainerDeepSpeedConfig.trainer_config_process)."""
+    cfg = copy.deepcopy(ds_config)
+
+    def set_path(path, value):
+        node = cfg
+        for p in path[:-1]:
+            node = node.get(p, {})
+            if not isinstance(node, dict):
+                return
+        if isinstance(node, dict) and is_auto(node.get(path[-1])):
+            node[path[-1]] = value
+
+    for path, src in _AUTO_SOURCES.items():
+        val = 0.0 if src is None else _get(args, src)
+        if val is not None:
+            set_path(path, val)
+
+    # betas come as a pair
+    node = cfg.get("optimizer", {}).get("params", {})
+    if is_auto(node.get("betas")):
+        node["betas"] = (float(_get(args, "adam_beta1", 0.9)),
+                         float(_get(args, "adam_beta2", 0.999)))
+
+    # scheduler steps
+    total = int(total_steps or _get(args, "max_steps", 0) or 10000)
+    sched = cfg.get("scheduler", {}).get("params", {})
+    if is_auto(sched.get("total_num_steps")):
+        sched["total_num_steps"] = total
+    if is_auto(sched.get("warmup_num_steps")):
+        sched["warmup_num_steps"] = _warmup_steps(args, total)
+
+    # finalize: no "auto" may survive except the batch spine, which the
+    # engine's batch math resolves once dp_world is known (reference raises
+    # the same way for unresolved auto fields)
+    spine = {"train_batch_size", "train_micro_batch_size_per_gpu",
+             "gradient_accumulation_steps"}
+    leftover = []
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, path + (k,))
+        elif is_auto(node) and path[0] not in spine:
+            leftover.append("/".join(map(str, path)))
+
+    walk(cfg, ())
+    if leftover:
+        raise ValueError(
+            f"unresolved 'auto' fields (no TrainingArguments source): "
+            f"{sorted(leftover)}")
+    return cfg

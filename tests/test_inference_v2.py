@@ -215,3 +215,23 @@ def test_scheduler_fuzz_block_ownership(devices, tiny_model):
         assert len(desc.tokens) == len(prompt) + mnew, \
             (uid, len(desc.tokens), len(prompt), mnew)
         assert desc.tokens[:len(prompt)] == prompt
+
+
+def test_burst_sampling(devices, tiny_model):
+    """Sampled bursts: valid tokens, reproducible per seed, varies across
+    seeds."""
+    cfg, params = tiny_model
+    mk = lambda: InferenceEngineV2(cfg, params, V2Config(
+        max_tokens_per_step=32, max_seqs=2, block_size=8, num_blocks=64,
+        max_blocks_per_seq=8, dtype="float32"))
+    out = []
+    for seed in (1, 1, 2):
+        eng = mk()
+        uid = eng.put([5, 6, 7], max_new_tokens=12)
+        res = eng.generate_all(temperature=1.0, seed=seed, burst=4)
+        toks = res[uid]
+        assert len(toks) == 15
+        assert all(0 <= t < cfg.vocab_size for t in toks[3:])
+        out.append(toks)
+    assert out[0] == out[1]  # same seed reproducible
+    assert out[0] != out[2]  # different seed differs
