@@ -362,7 +362,7 @@ _flash_attention_bhsd.defvjp(
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, sm_scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: int = 1024, block_k: int = 1024,
                     segment_ids=None, window: int = 0) -> jax.Array:
     """Fused attention. q: (B, S, H, D); k/v: (B, S, KV, D) with KV | H.
 
@@ -378,8 +378,22 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
 
-    block_q = min(block_q, S)
-    block_k = min(block_k, k.shape[1])
+    def pick_block(n: int, cap: int) -> int:
+        # small windows waste MXU work in huge tiles: shrink toward the band
+        if 0 < window < cap:
+            cap = max(128, window // 128 * 128 or 128)
+        if n <= cap:
+            return n
+        # largest sublane-aligned divisor of n not exceeding cap, so raising
+        # the default can never push a previously-fused shape onto the O(S²)
+        # fallback (e.g. S=1536: divisor 768, not min()=1024 → unusable)
+        for d in range(cap, 7, -1):
+            if n % d == 0 and d % 8 == 0:
+                return d
+        return cap  # no aligned divisor; the usable-gate will fall back
+
+    block_q = pick_block(S, block_q)
+    block_k = pick_block(k.shape[1], block_k)
     usable = (segment_ids is None and S % block_q == 0
               and k.shape[1] % block_k == 0 and H % KV == 0)
     if segment_ids is not None and window > 0:
