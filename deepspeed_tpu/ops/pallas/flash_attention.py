@@ -379,9 +379,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         sm_scale = 1.0 / math.sqrt(D)
 
     def pick_block(n: int, cap: int) -> int:
-        # small windows waste MXU work in huge tiles: shrink toward the band
+        # small windows waste MXU work in huge tiles: shrink the cap toward
+        # the band width (never raise it above the caller's request)
         if 0 < window < cap:
-            cap = max(128, window // 128 * 128 or 128)
+            cap = min(cap, max(128, window // 128 * 128))
         if n <= cap:
             return n
         # largest sublane-aligned divisor of n not exceeding cap, so raising
