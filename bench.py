@@ -70,11 +70,14 @@ def main() -> None:
 
     for _ in range(warmup):
         engine.train_batch(batch)
-    accel.synchronize()
+    # barrier = fetch a value produced by the last step: through the tunneled
+    # TPU backend, block_until_ready/synchronize can return before the
+    # dispatched work completes — only an actual device→host transfer awaits
+    jax.device_get(engine.state.step)
     t0 = time.perf_counter()
     for _ in range(steps):
         engine.train_batch(batch)
-    accel.synchronize()
+    jax.device_get(engine.state.step)
     dt = (time.perf_counter() - t0) / steps
 
     tokens_per_step = engine.train_batch_size * (seq - 1)

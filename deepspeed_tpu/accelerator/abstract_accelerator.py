@@ -53,7 +53,30 @@ class Accelerator(abc.ABC):
     def synchronize(self, device_index: Optional[int] = None) -> None:
         import jax
 
-        jax.effects_barrier()
+        # effects_barrier only awaits *effectful* computations; draining all
+        # in-flight work (the cudaDeviceSynchronize analogue) needs PJRT's
+        # per-device synchronize_all_activity.  An invalid device_index must
+        # fail loudly, so only the missing-method case falls back.
+        devs = jax.local_devices()
+        if device_index is not None:
+            devs = [devs[device_index]]
+        try:
+            for d in devs:
+                d.synchronize_all_activity()
+        except (AttributeError, NotImplementedError):
+            jax.effects_barrier()
+        # Some tunneled backends ack synchronize_all_activity before queued
+        # programs finish; a device→host fetch of a sentinel computation
+        # enqueued last drains the (in-order) compute stream for real.
+        import jax.numpy as jnp
+
+        for d in devs:
+            try:
+                jax.device_get(jax.jit(
+                    lambda: jnp.zeros((), jnp.int32),
+                    out_shardings=jax.sharding.SingleDeviceSharding(d))())
+            except Exception:
+                break
 
     def memory_stats(self, device_index: int = 0) -> Dict[str, int]:
         try:

@@ -71,7 +71,16 @@ def to_hf_llama(ckpt_dir: str, out_dir: str, num_layers: int) -> None:
     sd = params_to_hf_llama(tree, cfg)
     os.makedirs(out_dir, exist_ok=True)
     out = os.path.join(out_dir, "model.safetensors")
-    save_file({k: np.ascontiguousarray(v) for k, v in sd.items()}, out)
+    # safetensors.numpy cannot serialize ml_dtypes (bfloat16) — widen any
+    # non-native float (e.g. a bf16-trained checkpoint) to float32
+    def _serializable(v: np.ndarray) -> np.ndarray:
+        v = np.ascontiguousarray(v)
+        if v.dtype.kind == "f" and v.dtype.name not in (
+                "float16", "float32", "float64"):
+            return v.astype(np.float32)
+        return v
+
+    save_file({k: _serializable(v) for k, v in sd.items()}, out)
     print(f"wrote {out}: {len(sd)} tensors (HF LLaMA layout)")
 
 

@@ -53,6 +53,33 @@ def _stack(tensors) -> np.ndarray:
     return np.stack([np.asarray(t) for t in tensors])
 
 
+def _rope_unpermute(w_t: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """Convert q/k projection columns from HF's half-split RoPE layout to the
+    interleaved even/odd layout this repo's ``apply_rope`` uses.
+
+    HF LLaMA checkpoints store q/k pre-permuted so that ``rotate_half``
+    (first-half / second-half split) computes the rotation; our kernel rotates
+    adjacent (even, odd) pairs.  Per head, HF column order is
+    [j=0 block of head_dim/2, j=1 block]; interleaved order is (i, j) pairs.
+    This is a pure reparametrization: unpermuted weights + interleaved rope
+    ≡ HF weights + rotate_half, for any checkpoint using the HF convention.
+
+    ``w_t``: transposed projection, shape (in, n_heads*head_dim).
+    """
+    d_in = w_t.shape[0]
+    return (w_t.reshape(d_in, n_heads, 2, head_dim // 2)
+            .swapaxes(-1, -2)
+            .reshape(d_in, n_heads * head_dim))
+
+
+def _rope_permute(w_t: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """Inverse of :func:`_rope_unpermute` (interleaved → HF half-split)."""
+    d_in = w_t.shape[0]
+    return (w_t.reshape(d_in, n_heads, head_dim // 2, 2)
+            .swapaxes(-1, -2)
+            .reshape(d_in, n_heads * head_dim))
+
+
 def params_from_hf_llama(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
                          ) -> Dict[str, Any]:
     """LLaMA/Mistral-family HF state_dict → stacked param pytree.
@@ -68,12 +95,19 @@ def params_from_hf_llama(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
     def lnorm(pattern):
         return _stack([sd[pattern.format(i)] for i in range(L)])
 
+    def lw_rope(pattern, n_heads):  # q/k: transpose + half-split→interleaved
+        return _stack([
+            _rope_unpermute(sd[pattern.format(i)].T, n_heads, cfg.head_dim)
+            for i in range(L)])
+
     params: Dict[str, Any] = {
         "embed": {"tokens": sd["model.embed_tokens.weight"]},
         "layers": {
             "attn": {
-                "wq": lw("model.layers.{}.self_attn.q_proj.weight"),
-                "wk": lw("model.layers.{}.self_attn.k_proj.weight"),
+                "wq": lw_rope("model.layers.{}.self_attn.q_proj.weight",
+                              cfg.num_heads),
+                "wk": lw_rope("model.layers.{}.self_attn.k_proj.weight",
+                              cfg.kv_heads),
                 "wv": lw("model.layers.{}.self_attn.v_proj.weight"),
                 "wo": lw("model.layers.{}.self_attn.o_proj.weight"),
             },
@@ -139,8 +173,10 @@ def params_to_hf_llama(params: Dict[str, Any], cfg: tfm.TransformerConfig
     lp = params["layers"]
     for i in range(cfg.num_layers):
         pre = f"model.layers.{i}"
-        out[f"{pre}.self_attn.q_proj.weight"] = np.asarray(lp["attn"]["wq"][i]).T
-        out[f"{pre}.self_attn.k_proj.weight"] = np.asarray(lp["attn"]["wk"][i]).T
+        out[f"{pre}.self_attn.q_proj.weight"] = _rope_permute(
+            np.asarray(lp["attn"]["wq"][i]), cfg.num_heads, cfg.head_dim).T
+        out[f"{pre}.self_attn.k_proj.weight"] = _rope_permute(
+            np.asarray(lp["attn"]["wk"][i]), cfg.kv_heads, cfg.head_dim).T
         out[f"{pre}.self_attn.v_proj.weight"] = np.asarray(lp["attn"]["wv"][i]).T
         out[f"{pre}.self_attn.o_proj.weight"] = np.asarray(lp["attn"]["wo"][i]).T
         out[f"{pre}.mlp.gate_proj.weight"] = np.asarray(lp["mlp"]["w_gate"][i]).T

@@ -189,7 +189,7 @@ class PipelineConfig(DSConfigModel):
     stages: Union[int, str] = AUTO
     partition_method: str = "uniform"  # uniform | parameters | type:<regex>
     num_microbatches: Union[int, str] = AUTO
-    schedule: str = "1f1b"  # 1f1b | gpipe | interleaved
+    schedule: str = "1f1b"  # 1f1b | gpipe (consumed by make_pipeline_loss_fn)
     activation_checkpoint_interval: int = 0
 
 

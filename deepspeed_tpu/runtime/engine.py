@@ -17,6 +17,7 @@ sharding makes the per-layer all-gathers part of the scanned program.
 
 from __future__ import annotations
 
+import collections.abc
 import dataclasses
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
@@ -42,6 +43,49 @@ from .zero.sharding import (rules_for_optimizer, rules_for_params,
                             sharding_for_tree)
 
 LossFn = Callable[..., Tuple[jax.Array, Dict[str, jax.Array]]]
+
+
+class LazyMetrics(collections.abc.Mapping):
+    """Per-step metrics whose device→host transfer is deferred to first read.
+
+    Blocking on every step's scalars would serialize the host loop with the
+    device (each ``float()`` drains the async dispatch queue), exposing the
+    next batch's H2D copy and dispatch latency.  Returning this instead lets
+    callers that ignore or batch-read metrics keep the pipeline full; any
+    access materializes all values as plain floats.
+
+    Deliberately NOT a dict subclass: CPython's C fast paths (json.dumps,
+    PyDict_Merge, .copy) read a dict subclass's raw storage without calling
+    the overridden accessors and would silently see an empty dict.  As a
+    Mapping, ``dict(m)`` / ``{**m}`` go through keys()+__getitem__ correctly
+    and json.dumps fails loudly (convert with ``dict(m)`` first).
+    """
+
+    def __init__(self, device_metrics: Dict[str, jax.Array]):
+        self._dev: Optional[Dict[str, jax.Array]] = device_metrics
+        self._host: Dict[str, float] = {}
+
+    def _materialize(self) -> Dict[str, float]:
+        if self._dev is not None:
+            host = jax.device_get(self._dev)
+            self._dev = None
+            self._host = {k: float(v) for k, v in host.items()}
+        return self._host
+
+    def __getitem__(self, k):
+        return self._materialize()[k]
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __len__(self):
+        return len(self._materialize())
+
+    def __repr__(self):
+        return repr(self._materialize())
+
+    def __reduce__(self):  # pickle as a plain dict
+        return (dict, (self._materialize(),))
 
 
 @dataclasses.dataclass
@@ -189,7 +233,8 @@ class TrainingEngine:
         # ---- observability -------------------------------------------
         self.timers = SynchronizedWallClockTimer(synchronize=config.wall_clock_breakdown)
         self.tput = ThroughputTimer(batch_size=self.batch_config.train_batch_size,
-                                    steps_per_output=config.steps_per_print)
+                                    steps_per_output=config.steps_per_print,
+                                    synchronize=config.wall_clock_breakdown)
         self.monitor = self._configure_monitor()
         self.global_steps = 0
         log_dist(f"engine ready: zero_stage={stage} topo={topo} "
@@ -522,22 +567,33 @@ class TrainingEngine:
     # public API (reference surface)
     # ------------------------------------------------------------------
 
-    def train_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+    def train_batch(self, batch: Dict[str, np.ndarray]
+                    ) -> "collections.abc.Mapping[str, float]":
         """One full global-batch step (fwd+bwd+opt).  Reference:
-        ``PipelineEngine.train_batch`` / engine forward+backward+step."""
+        ``PipelineEngine.train_batch`` / engine forward+backward+step.
+
+        Returns a Mapping (LazyMetrics): reads materialize floats; convert
+        with ``dict(m)`` for serialization.  Not a dict instance."""
         self.tput.start()
         placed = self._place_batch(batch)
         if self.offload_enabled:
             out = self._train_batch_offloaded(placed)
         else:
             self.state, metrics = self._train_step(self.state, placed)
-            out = {k: float(v) for k, v in metrics.items()}
+            out = LazyMetrics(metrics)
         self.global_steps += 1
+        will_read = self.monitor.enabled or (
+            self.config.steps_per_print
+            and self.global_steps % self.config.steps_per_print == 0)
+        if will_read and isinstance(out, LazyMetrics):
+            # materialize INSIDE the throughput window so the blocking wait
+            # counts as step time — otherwise samples/sec reports dispatch rate
+            out._materialize()
         self.tput.stop()
         self._write_monitor(out)
         if self.config.steps_per_print and \
                 self.global_steps % self.config.steps_per_print == 0:
-            log_dist(f"step={self.global_steps} loss={out['loss']:.4f} "
+            log_dist(f"step={self.global_steps} loss={out.get('loss', float('nan')):.4f} "
                      f"lr={out['lr']:.2e} grad_norm={out.get('grad_norm', 0.0):.3f}")
         return out
 

@@ -26,12 +26,12 @@ def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
 
     def sched(step):
         step = jnp.asarray(step, jnp.float32)
-        t = jnp.clip(step / max(warmup_num_steps, 1), 0.0, 1.0)
         if warmup_type == "log":
-            # log-space interpolation (matches reference's log warmup)
-            frac = jnp.where(t > 0, jnp.log1p(t * (math.e - 1.0)), 0.0)
+            # reference WarmupLR: log(step+1) / log(warmup_num_steps)
+            denom = math.log(max(warmup_num_steps, 2))
+            frac = jnp.clip(jnp.log(step + 1.0) / denom, 0.0, 1.0)
         else:
-            frac = t
+            frac = jnp.clip(step / max(warmup_num_steps, 1), 0.0, 1.0)
         return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
 
     return sched

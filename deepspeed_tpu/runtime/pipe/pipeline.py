@@ -16,9 +16,22 @@ single SPMD program over the ``pp`` mesh axis:
   2(P-1)/(M+P-1); embeddings/logits stay outside the pipelined region (they
   live on every rank, the analogue of TiedLayerSpec replication).
 
-``schedule='1f1b'`` currently lowers to this GPipe dataflow (XLA's scheduler
-overlaps the ppermute with stage compute; an explicit interleaved 1F1B is
-tracked for a later round).
+Two schedules, selected by the ``schedule`` argument of
+:func:`pipeline_loss_fn` (or from a DeepSpeed-style config's
+``pipeline.schedule`` key via :func:`make_pipeline_loss_fn`):
+
+* ``'gpipe'`` — forward scan + jax autodiff backward.  Residuals for all M
+  microbatch ticks are stored: peak activation memory O(M).
+* ``'1f1b'`` — true interleaved one-forward-one-backward
+  (reference ``runtime/pipe/schedule.py:189`` ``TrainSchedule``): a single
+  scan over M + 2P - 1 ticks where EVERY tick runs one stage forward and one
+  stage backward (hand-written vjp), with per-stage input ring buffers of
+  depth 2P — peak activation memory O(P), independent of M.  The last stage
+  seeds each microbatch's backward from the loss head the tick after its
+  forward, exactly the reference's steady state.  Exposed through
+  ``jax.custom_vjp`` (forward computes loss AND grads; backward scales the
+  stored grads by the cotangent), so it drops into the engine's ordinary
+  ``value_and_grad`` path, loss scaling included.
 """
 
 from __future__ import annotations
@@ -29,10 +42,22 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.topology import MeshTopology, get_topology
+
+
+def _check_microbatch_divisibility(B: int, topo, M: int) -> None:
+    """The global batch is split over dp*fsdp shards BEFORE microbatching —
+    each shard's slice must divide evenly into M microbatches."""
+    b_shards = topo.size("dp") * topo.size("fsdp")
+    if (B // b_shards) % M != 0:
+        raise ValueError(
+            f"per-data-shard batch {B}//{b_shards}={B // b_shards} not "
+            f"divisible by num_microbatches {M} (global batch {B} is split "
+            f"over dp*fsdp={b_shards} shards before microbatching)")
 
 
 def _stage_fn(layer_params, x, cfg, attn_fn, cos, sin):
@@ -79,9 +104,7 @@ def pipeline_apply(layer_params: Dict[str, Any], x: jax.Array, cfg,
 
     B, S, H = x.shape
     M = num_microbatches
-    if B % M != 0:
-        raise ValueError(f"batch {B} not divisible by num_microbatches {M}")
-    mb = B // M
+    _check_microbatch_divisibility(B, topo, M)
     if cfg.attn_impl in ("ulysses", "ring") and attn_fn is None:
         # distributed attention binds the 'sp' axis with its own shard_map,
         # which cannot nest inside the pipeline's shard_map; within a stage
@@ -142,11 +165,249 @@ def pipeline_apply(layer_params: Dict[str, Any], x: jax.Array, cfg,
                      check_vma=False)(layer_params, x)
 
 
+def make_pipeline_loss_fn(cfg, ds_config=None, attn_fn=None):
+    """Build a pipelined loss_fn from a DeepSpeed-style config's ``pipeline``
+    section (``schedule``, ``num_microbatches``) — the wiring for
+    PipelineConfig (reference: engine.py consuming the ``pipeline`` dict).
+
+    ``ds_config`` may be a dict (the JSON config), a DeepSpeedTPUConfig, or
+    None (defaults: schedule='1f1b', num_microbatches=2).
+    """
+    from ..config import DeepSpeedTPUConfig, PipelineConfig
+    from ..config_utils import is_auto
+
+    if ds_config is None:
+        pipe_cfg = PipelineConfig()
+    elif isinstance(ds_config, DeepSpeedTPUConfig):
+        pipe_cfg = ds_config.pipeline
+    else:
+        pipe_cfg = PipelineConfig(**dict(ds_config).get("pipeline", {}))
+    m = pipe_cfg.num_microbatches
+    num_microbatches = 2 if is_auto(m) else int(m)
+
+    def loss_fn(params, batch, rng=None):
+        return pipeline_loss_fn(params, batch, cfg, num_microbatches,
+                                attn_fn=attn_fn, schedule=pipe_cfg.schedule)
+
+    return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (interleaved) schedule
+# ---------------------------------------------------------------------------
+
+
+def _head_loss(h, head_params, labels, mask, cfg):
+    """Final norm + logits + CE, SUMMED over this microbatch's tokens; aux is
+    the correct-prediction count.  (The last pipeline stage runs this per
+    microbatch to seed its backward — the reference's loss+``backward``
+    instructions at schedule.py:227.)"""
+    from ...models import transformer as tfm
+
+    dt = jnp.dtype(cfg.dtype)
+    h = tfm._norm(h, head_params["final_norm"], cfg.norm, cfg.norm_eps)
+    logits = (h @ head_params["w"].astype(dt)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    correct = ((logits.argmax(-1) == labels).astype(jnp.float32) * mask).sum()
+    return (nll * mask).sum(), correct
+
+
+def _run_1f1b(layer_params, head_params, x, labels, mask, cfg, M, attn_fn,
+              topo):
+    """One-forward-one-backward pipeline: a single shard_map'd scan computing
+    the summed loss AND all grads.
+
+    Schedule (P = pp size, ticks t = 0..M+2P-2; every stage does one forward
+    unit and one backward unit per tick):
+      forward  of microbatch m at stage i on tick  t = i + m
+      backward of microbatch m at stage i on tick  t = 2P - 1 - i + m
+    In-flight microbatches at stage i = 2(P - i) - 1 ≤ 2P - 1, so saved stage
+    inputs live in a ring buffer of depth 2P — O(P) activation memory where
+    GPipe-through-autodiff stores O(M) tick residuals.  Backward units
+    recompute the stage forward from the saved input (vjp), the pipelined
+    equivalent of per-layer remat.
+    """
+    from ...models import transformer as tfm
+
+    P_ = topo.size("pp")
+    n = P_
+    B, S, H = x.shape
+    cos, sin = (None, None)
+    if cfg.position == "rope":
+        cos, sin = tfm.rope_table(S, cfg.head_dim, cfg.rope_theta)
+
+    def stage(lp, xin):
+        return _stage_fn(lp, xin, cfg, attn_fn, cos, sin)
+
+    def local(lp, hp, x, labels, mask):
+        me = lax.axis_index("pp")
+        b_l, s_l, h_l = x.shape
+        mb_l = b_l // M
+        xm = x.reshape(M, mb_l, s_l, h_l)
+        lm = labels.reshape(M, mb_l, s_l)
+        mm = mask.reshape(M, mb_l, s_l)
+        R = 2 * n  # ring depth: ≥ max in-flight (2n-1, at stage 0)
+        fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+        bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+        T = M + 2 * n - 1
+
+        g_lp0 = jax.tree.map(jnp.zeros_like, lp)
+        g_hp0 = jax.tree.map(jnp.zeros_like, hp)
+
+        def tick(carry, t):
+            (in_buf, fwd_in, bwd_in, g_lp, g_hp, dx_buf, loss_sum,
+             correct_sum) = carry
+
+            # ---- forward unit: microbatch m_f = t - me ------------------
+            m_f = t - me
+            f_valid = (m_f >= 0) & (m_f < M)
+            m_f_c = jnp.clip(m_f, 0, M - 1)
+            inject = lax.dynamic_index_in_dim(xm, m_f_c, 0, keepdims=False)
+            x_in = jnp.where(me == 0, inject, fwd_in)
+            slot_f = jnp.remainder(m_f_c, R)
+            prev = lax.dynamic_index_in_dim(in_buf, slot_f, 0, keepdims=False)
+            in_buf = lax.dynamic_update_index_in_dim(
+                in_buf, jnp.where(f_valid, x_in, prev), slot_f, 0)
+            y = stage(lp, x_in)
+
+            # ---- backward unit: microbatch m_b = t - (2n - 1 - me) ------
+            m_b = t - (2 * n - 1 - me)
+            b_valid = (m_b >= 0) & (m_b < M)
+            m_b_c = jnp.clip(m_b, 0, M - 1)
+            slot_b = jnp.remainder(m_b_c, R)
+            x_saved = lax.dynamic_index_in_dim(in_buf, slot_b, 0, keepdims=False)
+            lab_b = lax.dynamic_index_in_dim(lm, m_b_c, 0, keepdims=False)
+            msk_b = lax.dynamic_index_in_dim(mm, m_b_c, 0, keepdims=False)
+
+            def last_stage_bwd(x_s, g_in, lab, msk):
+                # loss head + stage in ONE vjp: a single recompute yields the
+                # microbatch loss, stage/head param grads, and the input grad
+                def full(lp_, hp_, x_):
+                    return _head_loss(stage(lp_, x_), hp_, lab, msk, cfg)
+
+                (l, corr), (dlp, dhp, dxi) = jax.value_and_grad(
+                    full, argnums=(0, 1, 2), has_aux=True)(lp, hp, x_s)
+                return l, corr, dlp, dhp, dxi
+
+            def mid_stage_bwd(x_s, g_in, lab, msk):
+                _, vjp_fn = jax.vjp(lambda lp_, x_: stage(lp_, x_), lp, x_s)
+                dlp, dxi = vjp_fn(g_in)
+                z = jnp.zeros((), jnp.float32)
+                return z, z, dlp, g_hp0, dxi
+
+            l_m, c_m, dlp, dhp, dxi = lax.cond(
+                me == n - 1, last_stage_bwd, mid_stage_bwd,
+                x_saved, bwd_in, lab_b, msk_b)
+
+            g_lp = jax.tree.map(
+                lambda a, d: a + jnp.where(b_valid, d, jnp.zeros_like(d)),
+                g_lp, dlp)
+            g_hp = jax.tree.map(
+                lambda a, d: a + jnp.where(b_valid, d, jnp.zeros_like(d)),
+                g_hp, dhp)
+            loss_sum = loss_sum + jnp.where(b_valid, l_m, 0.0)
+            correct_sum = correct_sum + jnp.where(b_valid, c_m, 0.0)
+            dxi = jnp.where(b_valid, dxi, jnp.zeros_like(dxi))
+            dx_buf = lax.dynamic_update_index_in_dim(
+                dx_buf,
+                jnp.where(b_valid,
+                          dxi,
+                          lax.dynamic_index_in_dim(dx_buf, m_b_c, 0,
+                                                   keepdims=False)),
+                m_b_c, 0)
+
+            # hand-offs (SendActivation / SendGrad, on ICI)
+            fwd_in = lax.ppermute(y, "pp", fwd_perm)
+            bwd_in = lax.ppermute(dxi, "pp", bwd_perm)
+            return (in_buf, fwd_in, bwd_in, g_lp, g_hp, dx_buf, loss_sum,
+                    correct_sum), None
+
+        carry0 = (
+            jnp.zeros((R, mb_l, s_l, h_l), x.dtype),
+            jnp.zeros((mb_l, s_l, h_l), x.dtype),
+            jnp.zeros((mb_l, s_l, h_l), x.dtype),
+            g_lp0, g_hp0,
+            jnp.zeros((M, mb_l, s_l, h_l), x.dtype),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+        )
+        (in_buf, _, _, g_lp, g_hp, dx_buf, loss_sum,
+         correct_sum), _ = lax.scan(tick, carry0, jnp.arange(T))
+
+        # reductions: batch axes shard the data → sum grads/loss across them;
+        # g_hp/loss live on the last pp stage, dx on stage 0 — psum selects
+        batch_axes = ("dp", "fsdp")
+        g_lp = jax.tree.map(lambda a: lax.psum(a, batch_axes), g_lp)
+        g_hp = jax.tree.map(
+            lambda a: lax.psum(
+                jnp.where(me == n - 1, a, jnp.zeros_like(a)),
+                batch_axes + ("pp",)),
+            g_hp)
+        loss_sum = lax.psum(jnp.where(me == n - 1, loss_sum, 0.0),
+                            batch_axes + ("pp",))
+        correct_sum = lax.psum(jnp.where(me == n - 1, correct_sum, 0.0),
+                               batch_axes + ("pp",))
+        dx = lax.psum(jnp.where(me == 0, dx_buf, jnp.zeros_like(dx_buf)),
+                      ("pp",))
+        return g_lp, g_hp, dx.reshape(b_l, s_l, h_l), loss_sum, correct_sum
+
+    batch_axes = ("dp", "fsdp")
+    x_spec = P(batch_axes, None, None)
+    lab_spec = P(batch_axes, None)
+    param_spec = jax.tree.map(lambda _: P("pp"), layer_params)
+    head_spec = jax.tree.map(lambda _: P(), head_params)
+    g_lp, g_hp, dx, loss_sum, correct_sum = shard_map(
+        local, mesh=topo.mesh,
+        in_specs=(param_spec, head_spec, x_spec, lab_spec, lab_spec),
+        out_specs=(param_spec, head_spec, x_spec, P(), P()),
+        check_vma=False)(layer_params, head_params, x, labels, mask)
+    return (loss_sum, correct_sum), (g_lp, g_hp, dx)
+
+
+def _make_1f1b_fn(cfg, M: int, attn_fn, topo):
+    """Build the custom_vjp wrapper: forward computes loss AND grads (that is
+    what interleaving means — backward work happens inside the schedule);
+    backward just scales the stored grads by the loss cotangent."""
+
+    @jax.custom_vjp
+    def f(layer_params, head_params, x, labels, mask):
+        sums, _ = _run_1f1b(layer_params, head_params, x, labels, mask,
+                            cfg, M, attn_fn, topo)
+        return sums
+
+    def f_fwd(layer_params, head_params, x, labels, mask):
+        sums, grads = _run_1f1b(layer_params, head_params, x, labels,
+                                mask, cfg, M, attn_fn, topo)
+        return sums, grads
+
+    def f_bwd(res, g):
+        g_lp, g_hp, dx = res
+        g_loss = g[0]  # cotangent of loss_sum; correct_sum is non-diff
+
+        def scale(t):
+            return jax.tree.map(lambda a: a * g_loss.astype(a.dtype), t)
+
+        # labels are integer (float0 tangent); the mask is non-differentiated
+        return (scale(g_lp), scale(g_hp), dx * g_loss.astype(dx.dtype),
+                np.zeros(dx.shape[:2], jax.dtypes.float0),
+                jnp.zeros(dx.shape[:2], jnp.float32))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
 def pipeline_loss_fn(params, batch, cfg, num_microbatches: int = 2,
-                     attn_fn=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+                     attn_fn=None, schedule: str = "gpipe",
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Drop-in loss_fn running the layer stack through the pipeline.
     Reference surface: ``PipelineEngine.train_batch`` semantics (loss averaged
-    over microbatches) but differentiable as one program."""
+    over microbatches) but differentiable as one program.
+
+    ``schedule='gpipe'`` stores O(M) residuals and backprops via autodiff;
+    ``schedule='1f1b'`` runs the interleaved schedule with O(P) activation
+    memory (see module docstring).  Grads are exactly equal between the two.
+    """
     from ...models import transformer as tfm
 
     dt = jnp.dtype(cfg.dtype)
@@ -156,6 +417,36 @@ def pipeline_loss_fn(params, batch, cfg, num_microbatches: int = 2,
     x = params["embed"]["tokens"].astype(dt)[tokens]
     if cfg.position == "learned":
         x = x + params["embed"]["position"].astype(dt)[None, :S]
+
+    if schedule == "1f1b" and get_topology().size("pp") > 1:
+        topo = get_topology()
+        M = num_microbatches
+        _check_microbatch_divisibility(B, topo, M)
+        if attn_fn is None:
+            if cfg.attn_impl in ("ulysses", "ring"):
+                raise ValueError(
+                    "attn_impl='ulysses'/'ring' cannot run inside the "
+                    "pipelined stack; use 'flash' or 'xla'")
+            attn_fn = tfm.resolve_attention(cfg.attn_impl)
+        labels, mask = tfm.shift_labels(batch)
+        if mask is None:
+            mask = jnp.ones_like(labels, jnp.float32)
+        mask = mask.astype(jnp.float32)
+        if cfg.tie_embeddings:
+            w = params["embed"]["tokens"].T
+        else:
+            w = params["lm_head"]["w"]
+        head_params = {"final_norm": params["final_norm"], "w": w}
+        f = _make_1f1b_fn(cfg, M, attn_fn, topo)
+        loss_sum, correct_sum = f(params["layers"], head_params, x, labels,
+                                  mask)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = loss_sum / denom
+        return loss, {"loss": loss, "accuracy": correct_sum / denom,
+                      "tokens": denom}
+    if schedule not in ("gpipe", "1f1b"):  # 1f1b at pp=1 == dense fallthrough
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         "(supported: 'gpipe', '1f1b')")
 
     x = pipeline_apply(params["layers"], x, cfg, num_microbatches,
                        attn_fn=attn_fn)

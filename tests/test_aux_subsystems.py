@@ -255,6 +255,50 @@ def test_hf_gpt2_real_model_conversion(devices):
                                atol=2e-4, rtol=2e-3)
 
 
+def test_hf_llama_golden_logits(devices):
+    """Golden test vs transformers LlamaForCausalLM: HF checkpoints store q/k
+    pre-permuted for rotate_half RoPE; our interleaved apply_rope needs the
+    un-permutation in params_from_hf_llama.  Self-consistent round-trips can't
+    catch that — only comparing against HF's own forward can."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from deepspeed_tpu.models.hf_integration import load_hf_model
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(hf_cfg).eval()
+
+    cfg, params = load_hf_model(hf)
+    cfg = tfm.TransformerConfig(**{**cfg.__dict__, "dtype": "float32",
+                                   "param_dtype": "float32"})
+    tokens = np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    ours = np.asarray(tfm.forward(params, tokens, cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_hf_llama_export_roundtrip_hf_layout(devices):
+    """Export → re-import keeps HF layout invariant (permute is inverse of
+    unpermute), GQA included."""
+    from deepspeed_tpu.models.hf_integration import (params_from_hf_llama,
+                                                     params_to_hf_llama)
+
+    cfg = tfm.get_config("tiny", tie_embeddings=False, dtype="float32",
+                         num_heads=4, num_kv_heads=2)
+    params = tfm.init_params(jax.random.PRNGKey(1), cfg)
+    sd = params_to_hf_llama(params, cfg)
+    sd2 = params_to_hf_llama(params_from_hf_llama(sd, cfg), cfg)
+    for k in sd:
+        np.testing.assert_allclose(sd[k], sd2[k], atol=1e-7, err_msg=k)
+
+
 # ---------------------------------------------------------------------------
 # HF Trainer integration (auto-value contract)
 # ---------------------------------------------------------------------------

@@ -155,3 +155,20 @@ def test_zeropp_rejects_offload(devices):
         deepspeed_tpu.initialize(model=_spec(), config=dict(
             BASE, zero_optimization={"stage": 1, "zero_quantized_gradients": True,
                                      "offload_optimizer": {"device": "cpu"}}))
+
+
+def test_train_batch_metrics_mapping_semantics(devices):
+    """train_batch returns lazily-materialized metrics that must behave like a
+    real mapping under every read path (dict(), {**m}, iteration, get)."""
+    from tests.simple_model import tiny_lm_spec, copy_task_batch
+
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_lm_spec(), config=BASE)
+    batch = copy_task_batch(np.random.default_rng(0), engine.train_batch_size, 16)
+    m = engine.train_batch(batch)
+    as_dict = dict(m)
+    assert "loss" in as_dict and isinstance(as_dict["loss"], float)
+    merged = {**m}
+    assert merged["loss"] == as_dict["loss"]
+    assert set(iter(m)) == set(as_dict)
+    assert m.get("definitely_missing", 1.23) == 1.23
+    assert np.isfinite(m["loss"])
