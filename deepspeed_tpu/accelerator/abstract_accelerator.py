@@ -11,7 +11,18 @@ communication-backend name, and the named-op registry (the op-builder role).
 from __future__ import annotations
 
 import abc
+import functools
 from typing import Any, Dict, List, Optional
+
+
+@functools.lru_cache(maxsize=None)
+def _sentinel_fn(device):
+    """Cached per-device jitted no-op whose fetched result drains the queue."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda: jnp.zeros((), jnp.int32),
+                   out_shardings=jax.sharding.SingleDeviceSharding(device))
 
 
 class Accelerator(abc.ABC):
@@ -68,15 +79,11 @@ class Accelerator(abc.ABC):
         # Some tunneled backends ack synchronize_all_activity before queued
         # programs finish; a device→host fetch of a sentinel computation
         # enqueued last drains the (in-order) compute stream for real.
-        import jax.numpy as jnp
-
         for d in devs:
             try:
-                jax.device_get(jax.jit(
-                    lambda: jnp.zeros((), jnp.int32),
-                    out_shardings=jax.sharding.SingleDeviceSharding(d))())
+                jax.device_get(_sentinel_fn(d)())
             except Exception:
-                break
+                continue
 
     def memory_stats(self, device_index: int = 0) -> Dict[str, int]:
         try:

@@ -57,16 +57,17 @@ def forward_cached(params, tokens, cache, start_pos, cfg: tfm.TransformerConfig)
         x = x + params["embed"]["position"].astype(dt)[pos_ids][None]
     cos_full, sin_full = (None, None)
     if cfg.position == "rope":
-        cos_full, sin_full = tfm.rope_table(max_len, cfg.head_dim, cfg.rope_theta)
+        cos_full, sin_full = tfm.rope_table(max_len, cfg.rot_dim, cfg.rope_theta)
 
     def layer_body(carry, inputs):
         h, = carry
         layer_params, layer_k, layer_v = inputs
         a_in = tfm._norm(h, layer_params["ln1"], cfg.norm, cfg.norm_eps)
         nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-        q = (a_in @ layer_params["attn"]["wq"].astype(dt)).reshape(B, T, nh, hd)
-        k = (a_in @ layer_params["attn"]["wk"].astype(dt)).reshape(B, T, nkv, hd)
-        v = (a_in @ layer_params["attn"]["wv"].astype(dt)).reshape(B, T, nkv, hd)
+        ap = layer_params["attn"]
+        q = tfm._lin(a_in, ap, "wq", "bq").reshape(B, T, nh, hd)
+        k = tfm._lin(a_in, ap, "wk", "bk").reshape(B, T, nkv, hd)
+        v = tfm._lin(a_in, ap, "wv", "bv").reshape(B, T, nkv, hd)
         if cfg.position == "rope":
             cos = jax.lax.dynamic_slice_in_dim(cos_full, start_pos, T)
             sin = jax.lax.dynamic_slice_in_dim(sin_full, start_pos, T)
@@ -93,15 +94,18 @@ def forward_cached(params, tokens, cache, start_pos, cfg: tfm.TransformerConfig)
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(dt)
         o = jnp.einsum("bhts,bshd->bthd", probs, vv).reshape(B, T, nh * hd)
-        h = h + o @ layer_params["attn"]["wo"].astype(dt)
+        attn_out = tfm._lin(o, ap, "wo", "bo")
 
-        m_in = tfm._norm(h, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+        m_src = h if cfg.parallel_residual else h + attn_out
+        m_in = tfm._norm(m_src, layer_params["ln2"], cfg.norm, cfg.norm_eps)
         if cfg.num_experts > 0:
             from ..moe.layer import dense_moe_block
 
-            h = h + dense_moe_block(m_in, layer_params["moe"], cfg)
+            mlp_out = dense_moe_block(m_in, layer_params["moe"], cfg)
         else:
-            h = h + tfm._mlp_block(m_in, layer_params["mlp"], cfg)
+            mlp_out = tfm._mlp_block(m_in, layer_params["mlp"], cfg)
+        h = (h + attn_out + mlp_out) if cfg.parallel_residual \
+            else (m_src + mlp_out)
         return (h,), (new_k, new_v)
 
     (x,), (new_ks, new_vs) = jax.lax.scan(
@@ -141,7 +145,9 @@ class InferenceEngine:
         self.topo = MeshTopology.from_config(
             MeshConfig(tensor_parallel_size=icfg.tensor_parallel_size))
         rules = rules_for_params(0, self.topo)
-        shardings = sharding_for_tree(params, tfm.param_axes(self.model_config),
+        shardings = sharding_for_tree(params,
+                                      tfm.param_axes(self.model_config,
+                                                     params=params),
                                       rules, self.topo)
         self.params = jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s),
                                    params, shardings)

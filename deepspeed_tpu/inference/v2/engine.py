@@ -93,7 +93,7 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
         cos_full, sin_full = (None, None)
         if model_cfg.position == "rope":
             max_len = v2.max_blocks_per_seq * bs
-            cos_full, sin_full = tfm.rope_table(max_len, model_cfg.head_dim,
+            cos_full, sin_full = tfm.rope_table(max_len, model_cfg.rot_dim,
                                                 model_cfg.rope_theta)
 
         # KV write positions: token t → (block_tables[seq, pos//bs], pos%bs)
@@ -112,9 +112,9 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
         def layer_body(x, inp):
             lp, k_cache, v_cache = inp
             a_in = tfm._norm(x, lp["ln1"], model_cfg.norm, model_cfg.norm_eps)
-            q = (a_in @ lp["attn"]["wq"].astype(dt)).reshape(T, nh, hd)
-            k = (a_in @ lp["attn"]["wk"].astype(dt)).reshape(T, nkv, hd)
-            v = (a_in @ lp["attn"]["wv"].astype(dt)).reshape(T, nkv, hd)
+            q = tfm._lin(a_in, lp["attn"], "wq", "bq").reshape(T, nh, hd)
+            k = tfm._lin(a_in, lp["attn"], "wk", "bk").reshape(T, nkv, hd)
+            v = tfm._lin(a_in, lp["attn"], "wv", "bv").reshape(T, nkv, hd)
             if model_cfg.position == "rope":
                 cos = cos_full[position_ids]
                 sin = sin_full[position_ids]
@@ -126,14 +126,18 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
             o = ragged_attention_xla(q, k_cache, v_cache, block_tables,
                                      context_lens, seq_index, position_ids,
                                      model_cfg, bs)
-            x = x + o.reshape(T, nh * hd) @ lp["attn"]["wo"].astype(dt)
-            m_in = tfm._norm(x, lp["ln2"], model_cfg.norm, model_cfg.norm_eps)
+            attn_out = tfm._lin(o.reshape(T, nh * hd), lp["attn"], "wo", "bo")
+            m_src = x if model_cfg.parallel_residual else x + attn_out
+            m_in = tfm._norm(m_src, lp["ln2"], model_cfg.norm,
+                             model_cfg.norm_eps)
             if model_cfg.num_experts > 0:
                 from ...moe.layer import dense_moe_block
 
-                x = x + dense_moe_block(m_in[None], lp["moe"], model_cfg)[0]
+                mlp_out = dense_moe_block(m_in[None], lp["moe"], model_cfg)[0]
             else:
-                x = x + tfm._mlp_block(m_in[None], lp["mlp"], model_cfg)[0]
+                mlp_out = tfm._mlp_block(m_in[None], lp["mlp"], model_cfg)[0]
+            x = (x + attn_out + mlp_out) if model_cfg.parallel_residual \
+                else (m_src + mlp_out)
             return x, (k_cache, v_cache)
 
         x, (new_k, new_v) = jax.lax.scan(
@@ -219,7 +223,7 @@ def _decode_body(params, caches, token_ids, position_ids, block_tables,
     cos_full, sin_full = (None, None)
     if model_cfg.position == "rope":
         max_len = v2.max_blocks_per_seq * bs
-        cos_full, sin_full = tfm.rope_table(max_len, model_cfg.head_dim,
+        cos_full, sin_full = tfm.rope_table(max_len, model_cfg.rot_dim,
                                             model_cfg.rope_theta)
     active = context_lens > 0
     blk_ids = jnp.where(
@@ -232,32 +236,40 @@ def _decode_body(params, caches, token_ids, position_ids, block_tables,
     def layer_body(x, inp):
         lp, k_cache, v_cache = inp
         a_in = tfm._norm(x, lp["ln1"], model_cfg.norm, model_cfg.norm_eps)
-        q = (a_in @ lp["attn"]["wq"].astype(dt)).reshape(S, nh, hd)
-        k = (a_in @ lp["attn"]["wk"].astype(dt)).reshape(S, nkv, hd)
-        v = (a_in @ lp["attn"]["wv"].astype(dt)).reshape(S, nkv, hd)
+        q = tfm._lin(a_in, lp["attn"], "wq", "bq").reshape(S, nh, hd)
+        k = tfm._lin(a_in, lp["attn"], "wk", "bk").reshape(S, nkv, hd)
+        v = tfm._lin(a_in, lp["attn"], "wv", "bv").reshape(S, nkv, hd)
         if model_cfg.position == "rope":
             cos = cos_full[position_ids][:, None, :].astype(dt)
             sin = sin_full[position_ids][:, None, :].astype(dt)
+            rd = model_cfg.rot_dim
 
             def rot(t):
-                t1, t2 = t[..., ::2], t[..., 1::2]
+                tr = t[..., :rd]
+                t1, t2 = tr[..., ::2], tr[..., 1::2]
                 o1 = t1 * cos - t2 * sin
                 o2 = t2 * cos + t1 * sin
-                return jnp.stack([o1, o2], axis=-1).reshape(t.shape)
+                out = jnp.stack([o1, o2], axis=-1).reshape(tr.shape)
+                if rd == t.shape[-1]:
+                    return out
+                return jnp.concatenate([out, t[..., rd:]], axis=-1)
 
             q, k = rot(q), rot(k)
         k_cache = k_cache.at[blk_ids, offsets].set(k.astype(k_cache.dtype))
         v_cache = v_cache.at[blk_ids, offsets].set(v.astype(v_cache.dtype))
         o = paged_decode_attention(q, k_cache, v_cache, block_tables,
                                    context_lens)
-        x = x + o.reshape(S, nh * hd) @ lp["attn"]["wo"].astype(dt)
-        m_in = tfm._norm(x, lp["ln2"], model_cfg.norm, model_cfg.norm_eps)
+        attn_out = tfm._lin(o.reshape(S, nh * hd), lp["attn"], "wo", "bo")
+        m_src = x if model_cfg.parallel_residual else x + attn_out
+        m_in = tfm._norm(m_src, lp["ln2"], model_cfg.norm, model_cfg.norm_eps)
         if model_cfg.num_experts > 0:
             from ...moe.layer import dense_moe_block
 
-            x = x + dense_moe_block(m_in[None], lp["moe"], model_cfg)[0]
+            mlp_out = dense_moe_block(m_in[None], lp["moe"], model_cfg)[0]
         else:
-            x = x + tfm._mlp_block(m_in[None], lp["mlp"], model_cfg)[0]
+            mlp_out = tfm._mlp_block(m_in[None], lp["mlp"], model_cfg)[0]
+        x = (x + attn_out + mlp_out) if model_cfg.parallel_residual \
+            else (m_src + mlp_out)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(

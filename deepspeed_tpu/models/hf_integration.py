@@ -20,11 +20,21 @@ import numpy as np
 from . import transformer as tfm
 
 
+def _getter(hf_config) -> Callable:
+    return (hf_config.get if isinstance(hf_config, dict)
+            else lambda k, d=None: getattr(hf_config, k, d))
+
+
 def config_from_hf(hf_config) -> tfm.TransformerConfig:
-    """Map an HF config object/dict (LlamaConfig, GPT2Config, MixtralConfig)
-    to a TransformerConfig."""
-    get = (hf_config.get if isinstance(hf_config, dict)
-           else lambda k, d=None: getattr(hf_config, k, d))
+    """Map an HF config object/dict to a TransformerConfig.
+
+    The architecture map (reference role: ``module_inject/containers/`` — one
+    policy per HF architecture, ``replace_module.py:189``): each supported
+    ``model_type`` contributes its structural switches (norm flavor,
+    activation, residual topology, rotary fraction, fused layouts) on top of
+    the shared decoder schema.
+    """
+    get = _getter(hf_config)
     model_type = get("model_type", "llama")
     if model_type == "gpt2":
         return tfm.TransformerConfig(
@@ -33,7 +43,72 @@ def config_from_hf(hf_config) -> tfm.TransformerConfig:
             num_heads=get("n_head"), max_seq_len=get("n_positions", 1024),
             norm="layernorm", activation="gelu", position="learned",
             tie_embeddings=True)
+    if model_type == "gpt_neox":
+        return tfm.TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            rope_theta=get("rotary_emb_base", 10000.0),
+            partial_rotary_factor=get("rotary_pct", 1.0),
+            parallel_residual=bool(get("use_parallel_residual", True)),
+            norm="layernorm", activation="gelu_exact",
+            norm_eps=get("layer_norm_eps", 1e-5),
+            tie_embeddings=bool(get("tie_word_embeddings", False)))
+    if model_type == "falcon":
+        if get("alibi", False):
+            raise ValueError(
+                "ALiBi Falcon variants (falcon-rw-*) are not supported — "
+                "this map converts the rotary falcon family only")
+        nh = get("num_attention_heads")
+        if get("new_decoder_architecture", False):
+            nkv = get("num_kv_heads", nh)
+        else:
+            nkv = 1 if get("multi_query", True) else nh
+        return tfm.TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            intermediate_size=get("ffn_hidden_size") or 4 * get("hidden_size"),
+            num_layers=get("num_hidden_layers"), num_heads=nh,
+            num_kv_heads=nkv,
+            max_seq_len=get("max_position_embeddings", 2048),
+            rope_theta=get("rope_theta", 10000.0),
+            parallel_residual=bool(get("parallel_attn", True)),
+            norm="layernorm", activation="gelu_exact",
+            norm_eps=get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=bool(get("tie_word_embeddings", True)))
+    if model_type == "opt":
+        h = get("hidden_size")
+        if get("word_embed_proj_dim", h) != h:
+            raise ValueError("OPT word_embed_proj_dim != hidden_size "
+                             "(projected embeddings) is not supported")
+        if not get("do_layer_norm_before", True):
+            raise ValueError("OPT post-layernorm variant (350m) not supported")
+        return tfm.TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=h,
+            intermediate_size=get("ffn_dim"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm="layernorm", activation="relu", position="learned",
+            norm_eps=1e-5,
+            tie_embeddings=bool(get("tie_word_embeddings", True)))
+    if model_type == "phi3":
+        return tfm.TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            num_kv_heads=get("num_key_value_heads"),
+            max_seq_len=get("max_position_embeddings", 4096),
+            rope_theta=get("rope_theta", 10000.0),
+            norm_eps=get("rms_norm_eps", 1e-5),
+            tie_embeddings=bool(get("tie_word_embeddings", False)))
+    # llama / mistral / qwen2 / mixtral share the llama schema
     num_experts = get("num_local_experts", 0) or 0
+    sliding = get("sliding_window") or 0
+    if model_type == "qwen2" and not get("use_sliding_window", False):
+        sliding = 0
     return tfm.TransformerConfig(
         vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
         intermediate_size=get("intermediate_size"),
@@ -44,6 +119,8 @@ def config_from_hf(hf_config) -> tfm.TransformerConfig:
         rope_theta=get("rope_theta", 10000.0),
         norm_eps=get("rms_norm_eps", 1e-5),
         tie_embeddings=bool(get("tie_word_embeddings", False)),
+        sliding_window=sliding,
+        attn_impl="flash" if sliding else "xla",
         num_experts=num_experts,
         moe_top_k=get("num_experts_per_tok", 2) if num_experts else 2,
     )
@@ -53,31 +130,64 @@ def _stack(tensors) -> np.ndarray:
     return np.stack([np.asarray(t) for t in tensors])
 
 
-def _rope_unpermute(w_t: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+def _rope_unpermute(w_t: np.ndarray, n_heads: int, head_dim: int,
+                    rot_dim: Optional[int] = None) -> np.ndarray:
     """Convert q/k projection columns from HF's half-split RoPE layout to the
     interleaved even/odd layout this repo's ``apply_rope`` uses.
 
-    HF LLaMA checkpoints store q/k pre-permuted so that ``rotate_half``
-    (first-half / second-half split) computes the rotation; our kernel rotates
-    adjacent (even, odd) pairs.  Per head, HF column order is
-    [j=0 block of head_dim/2, j=1 block]; interleaved order is (i, j) pairs.
-    This is a pure reparametrization: unpermuted weights + interleaved rope
-    ≡ HF weights + rotate_half, for any checkpoint using the HF convention.
+    HF checkpoints compute rotary with ``rotate_half`` (first-half /
+    second-half split); our kernel rotates adjacent (even, odd) pairs.  Per
+    head, the rotate_half column order is [j=0 block of rot/2, j=1 block];
+    interleaved order is (i, j) pairs.  This is a pure reparametrization:
+    unpermuted weights + interleaved rope ≡ HF weights + rotate_half, for any
+    checkpoint using the HF convention.  With partial rotary (gpt-neox/phi),
+    only the first ``rot_dim`` dims of each head participate.
 
     ``w_t``: transposed projection, shape (in, n_heads*head_dim).
     """
+    rot = rot_dim or head_dim
     d_in = w_t.shape[0]
-    return (w_t.reshape(d_in, n_heads, 2, head_dim // 2)
-            .swapaxes(-1, -2)
-            .reshape(d_in, n_heads * head_dim))
+    w = w_t.reshape(d_in, n_heads, head_dim)
+    wr = (w[..., :rot].reshape(d_in, n_heads, 2, rot // 2)
+          .swapaxes(-1, -2).reshape(d_in, n_heads, rot))
+    return np.concatenate([wr, w[..., rot:]], axis=-1) \
+        .reshape(d_in, n_heads * head_dim)
 
 
-def _rope_permute(w_t: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+def _rope_permute(w_t: np.ndarray, n_heads: int, head_dim: int,
+                  rot_dim: Optional[int] = None) -> np.ndarray:
     """Inverse of :func:`_rope_unpermute` (interleaved → HF half-split)."""
+    rot = rot_dim or head_dim
     d_in = w_t.shape[0]
-    return (w_t.reshape(d_in, n_heads, head_dim // 2, 2)
-            .swapaxes(-1, -2)
-            .reshape(d_in, n_heads * head_dim))
+    w = w_t.reshape(d_in, n_heads, head_dim)
+    wr = (w[..., :rot].reshape(d_in, n_heads, rot // 2, 2)
+          .swapaxes(-1, -2).reshape(d_in, n_heads, rot))
+    return np.concatenate([wr, w[..., rot:]], axis=-1) \
+        .reshape(d_in, n_heads * head_dim)
+
+
+def _rope_unpermute_bias(b: np.ndarray, n_heads: int, head_dim: int,
+                         rot_dim: Optional[int] = None) -> np.ndarray:
+    """Bias rows are permuted exactly like weight output rows."""
+    return _rope_unpermute(b[None], n_heads, head_dim, rot_dim)[0]
+
+
+# shared per-layer stacking helpers (every converter maps "pattern with layer
+# index" → stacked (L, ...) arrays; torch Linear stores (out, in) → transpose)
+
+
+def _lw(sd, pattern: str, L: int) -> np.ndarray:
+    return _stack([sd[pattern.format(i)].T for i in range(L)])
+
+
+def _lnorm(sd, pattern: str, L: int) -> np.ndarray:
+    return _stack([sd[pattern.format(i)] for i in range(L)])
+
+
+def _lw_rope(sd, pattern: str, L: int, n_heads: int, head_dim: int,
+             rot_dim: Optional[int] = None) -> np.ndarray:
+    return _stack([_rope_unpermute(sd[pattern.format(i)].T, n_heads,
+                                   head_dim, rot_dim) for i in range(L)])
 
 
 def params_from_hf_llama(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
@@ -89,35 +199,25 @@ def params_from_hf_llama(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
     sd = {k: np.asarray(v) for k, v in state_dict.items()}
     L = cfg.num_layers
 
-    def lw(pattern):  # stacked, transposed linear weights
-        return _stack([sd[pattern.format(i)].T for i in range(L)])
-
-    def lnorm(pattern):
-        return _stack([sd[pattern.format(i)] for i in range(L)])
-
-    def lw_rope(pattern, n_heads):  # q/k: transpose + half-split→interleaved
-        return _stack([
-            _rope_unpermute(sd[pattern.format(i)].T, n_heads, cfg.head_dim)
-            for i in range(L)])
-
     params: Dict[str, Any] = {
         "embed": {"tokens": sd["model.embed_tokens.weight"]},
         "layers": {
             "attn": {
-                "wq": lw_rope("model.layers.{}.self_attn.q_proj.weight",
-                              cfg.num_heads),
-                "wk": lw_rope("model.layers.{}.self_attn.k_proj.weight",
-                              cfg.kv_heads),
-                "wv": lw("model.layers.{}.self_attn.v_proj.weight"),
-                "wo": lw("model.layers.{}.self_attn.o_proj.weight"),
+                "wq": _lw_rope(sd, "model.layers.{}.self_attn.q_proj.weight",
+                               L, cfg.num_heads, cfg.head_dim),
+                "wk": _lw_rope(sd, "model.layers.{}.self_attn.k_proj.weight",
+                               L, cfg.kv_heads, cfg.head_dim),
+                "wv": _lw(sd, "model.layers.{}.self_attn.v_proj.weight", L),
+                "wo": _lw(sd, "model.layers.{}.self_attn.o_proj.weight", L),
             },
-            "ln1": {"scale": lnorm("model.layers.{}.input_layernorm.weight")},
-            "ln2": {"scale": lnorm(
-                "model.layers.{}.post_attention_layernorm.weight")},
+            "ln1": {"scale": _lnorm(
+                sd, "model.layers.{}.input_layernorm.weight", L)},
+            "ln2": {"scale": _lnorm(
+                sd, "model.layers.{}.post_attention_layernorm.weight", L)},
             "mlp": {
-                "w_gate": lw("model.layers.{}.mlp.gate_proj.weight"),
-                "w_in": lw("model.layers.{}.mlp.up_proj.weight"),
-                "w_out": lw("model.layers.{}.mlp.down_proj.weight"),
+                "w_gate": _lw(sd, "model.layers.{}.mlp.gate_proj.weight", L),
+                "w_in": _lw(sd, "model.layers.{}.mlp.up_proj.weight", L),
+                "w_out": _lw(sd, "model.layers.{}.mlp.down_proj.weight", L),
             },
         },
         "final_norm": {"scale": sd["model.norm.weight"]},
@@ -130,37 +230,311 @@ def params_from_hf_llama(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
 def params_from_hf_gpt2(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
                         ) -> Dict[str, Any]:
     """GPT-2 HF state_dict → param pytree.  GPT-2 uses Conv1D ((in, out),
-    no transpose) and a fused c_attn; note our blocks are bias-free — biases
-    are folded away (exactness preserved only for bias-free finetunes)."""
+    no transpose) and a fused c_attn; linear biases are carried through."""
     sd = {k: np.asarray(v) for k, v in state_dict.items()}
     L, h = cfg.num_layers, cfg.hidden_size
 
-    qs, ks, vs, wos, w_ins, w_outs = [], [], [], [], [], []
-    ln1s, ln1b, ln2s, ln2b = [], [], [], []
-    for i in range(L):
-        c_attn = sd[f"h.{i}.attn.c_attn.weight"]  # (h, 3h)
-        qs.append(c_attn[:, :h])
-        ks.append(c_attn[:, h:2 * h])
-        vs.append(c_attn[:, 2 * h:])
-        wos.append(sd[f"h.{i}.attn.c_proj.weight"])
-        w_ins.append(sd[f"h.{i}.mlp.c_fc.weight"])
-        w_outs.append(sd[f"h.{i}.mlp.c_proj.weight"])
-        ln1s.append(sd[f"h.{i}.ln_1.weight"])
-        ln1b.append(sd[f"h.{i}.ln_1.bias"])
-        ln2s.append(sd[f"h.{i}.ln_2.weight"])
-        ln2b.append(sd[f"h.{i}.ln_2.bias"])
+    def per_layer(fn):
+        return _stack([fn(i) for i in range(L)])
 
     return {
         "embed": {"tokens": sd["wte.weight"], "position": sd["wpe.weight"]},
         "layers": {
-            "attn": {"wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
-                     "wo": _stack(wos)},
-            "ln1": {"scale": _stack(ln1s), "bias": _stack(ln1b)},
-            "ln2": {"scale": _stack(ln2s), "bias": _stack(ln2b)},
-            "mlp": {"w_in": _stack(w_ins), "w_out": _stack(w_outs)},
+            "attn": {
+                "wq": per_layer(lambda i: sd[f"h.{i}.attn.c_attn.weight"][:, :h]),
+                "wk": per_layer(lambda i: sd[f"h.{i}.attn.c_attn.weight"][:, h:2 * h]),
+                "wv": per_layer(lambda i: sd[f"h.{i}.attn.c_attn.weight"][:, 2 * h:]),
+                "wo": per_layer(lambda i: sd[f"h.{i}.attn.c_proj.weight"]),
+                "bq": per_layer(lambda i: sd[f"h.{i}.attn.c_attn.bias"][:h]),
+                "bk": per_layer(lambda i: sd[f"h.{i}.attn.c_attn.bias"][h:2 * h]),
+                "bv": per_layer(lambda i: sd[f"h.{i}.attn.c_attn.bias"][2 * h:]),
+                "bo": per_layer(lambda i: sd[f"h.{i}.attn.c_proj.bias"]),
+            },
+            "ln1": {"scale": per_layer(lambda i: sd[f"h.{i}.ln_1.weight"]),
+                    "bias": per_layer(lambda i: sd[f"h.{i}.ln_1.bias"])},
+            "ln2": {"scale": per_layer(lambda i: sd[f"h.{i}.ln_2.weight"]),
+                    "bias": per_layer(lambda i: sd[f"h.{i}.ln_2.bias"])},
+            "mlp": {
+                "w_in": per_layer(lambda i: sd[f"h.{i}.mlp.c_fc.weight"]),
+                "w_out": per_layer(lambda i: sd[f"h.{i}.mlp.c_proj.weight"]),
+                "b_in": per_layer(lambda i: sd[f"h.{i}.mlp.c_fc.bias"]),
+                "b_out": per_layer(lambda i: sd[f"h.{i}.mlp.c_proj.bias"]),
+            },
         },
         "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
     }
+
+
+def params_from_hf_qwen2(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
+                         ) -> Dict[str, Any]:
+    """Qwen2: LLaMA schema + q/k/v projection biases (bias rows carry the
+    same rotate_half permutation as the weight's output rows)."""
+    params = params_from_hf_llama(state_dict, cfg)
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, hd = cfg.num_layers, cfg.head_dim
+    if "model.layers.0.self_attn.q_proj.bias" in sd:
+        params["layers"]["attn"]["bq"] = _stack([
+            _rope_unpermute_bias(
+                sd[f"model.layers.{i}.self_attn.q_proj.bias"],
+                cfg.num_heads, hd) for i in range(L)])
+        params["layers"]["attn"]["bk"] = _stack([
+            _rope_unpermute_bias(
+                sd[f"model.layers.{i}.self_attn.k_proj.bias"],
+                cfg.kv_heads, hd) for i in range(L)])
+        params["layers"]["attn"]["bv"] = _stack([
+            sd[f"model.layers.{i}.self_attn.v_proj.bias"] for i in range(L)])
+    return params
+
+
+def params_from_hf_mixtral(state_dict: Dict[str, Any],
+                           cfg: tfm.TransformerConfig) -> Dict[str, Any]:
+    """Mixtral: LLaMA attention + block-sparse MoE FFN.  Expert weights stack
+    to (L, E, h, f)/(L, E, f, h); w1=gate, w3=up, w2=down; the router gate
+    transposes to (h, E).  Reference:
+    ``inference/v2/model_implementations/mixtral``."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, E = cfg.num_layers, cfg.num_experts
+
+    def experts(w_name):
+        return _stack([
+            np.stack([sd[f"model.layers.{i}.block_sparse_moe.experts."
+                         f"{e}.{w_name}.weight"].T for e in range(E)])
+            for i in range(L)])
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": sd["model.embed_tokens.weight"]},
+        "layers": {
+            "attn": {
+                "wq": _lw_rope(sd, "model.layers.{}.self_attn.q_proj.weight",
+                               L, cfg.num_heads, cfg.head_dim),
+                "wk": _lw_rope(sd, "model.layers.{}.self_attn.k_proj.weight",
+                               L, cfg.kv_heads, cfg.head_dim),
+                "wv": _lw(sd, "model.layers.{}.self_attn.v_proj.weight", L),
+                "wo": _lw(sd, "model.layers.{}.self_attn.o_proj.weight", L),
+            },
+            "ln1": {"scale": _stack(
+                [sd[f"model.layers.{i}.input_layernorm.weight"]
+                 for i in range(L)])},
+            "ln2": {"scale": _stack(
+                [sd[f"model.layers.{i}.post_attention_layernorm.weight"]
+                 for i in range(L)])},
+            "moe": {
+                "router": _lw(sd, "model.layers.{}.block_sparse_moe.gate.weight", L),
+                "w_gate": experts("w1"),
+                "w_out": experts("w2"),
+                "w_in": experts("w3"),
+            },
+        },
+        "final_norm": {"scale": sd["model.norm.weight"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"w": sd["lm_head.weight"].T}
+    return params
+
+
+def params_from_hf_phi3(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
+                        ) -> Dict[str, Any]:
+    """Phi-3: LLaMA schema with fused qkv_proj and gate_up_proj."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, hd, nh, nkv = cfg.num_layers, cfg.head_dim, cfg.num_heads, cfg.kv_heads
+    f = cfg.intermediate_size
+
+    def split_qkv(i):
+        w = sd[f"model.layers.{i}.self_attn.qkv_proj.weight"]  # (q+k+v, h)
+        q = _rope_unpermute(w[:nh * hd].T, nh, hd)
+        k = _rope_unpermute(w[nh * hd:nh * hd + nkv * hd].T, nkv, hd)
+        v = w[nh * hd + nkv * hd:].T
+        return q, k, v
+
+    qs, ks, vs = zip(*(split_qkv(i) for i in range(L)))
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": sd["model.embed_tokens.weight"]},
+        "layers": {
+            "attn": {"wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
+                     "wo": _lw(sd, "model.layers.{}.self_attn.o_proj.weight", L)},
+            "ln1": {"scale": _stack(
+                [sd[f"model.layers.{i}.input_layernorm.weight"]
+                 for i in range(L)])},
+            "ln2": {"scale": _stack(
+                [sd[f"model.layers.{i}.post_attention_layernorm.weight"]
+                 for i in range(L)])},
+            "mlp": {
+                "w_gate": _stack(
+                    [sd[f"model.layers.{i}.mlp.gate_up_proj.weight"][:f].T
+                     for i in range(L)]),
+                "w_in": _stack(
+                    [sd[f"model.layers.{i}.mlp.gate_up_proj.weight"][f:].T
+                     for i in range(L)]),
+                "w_out": _lw(sd, "model.layers.{}.mlp.down_proj.weight", L),
+            },
+        },
+        "final_norm": {"scale": sd["model.norm.weight"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"w": sd["lm_head.weight"].T}
+    return params
+
+
+def params_from_hf_falcon(state_dict: Dict[str, Any],
+                          cfg: tfm.TransformerConfig, hf_config=None
+                          ) -> Dict[str, Any]:
+    """Falcon: fused query_key_value (three layouts by generation), parallel
+    attention residual, GELU MLP.  Models with a single shared layernorm get
+    it duplicated into ln1/ln2 — mathematically identical to the shared
+    read."""
+    get = _getter(hf_config) if hf_config is not None else (lambda k, d=None: d)
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, hd, nh, nkv = cfg.num_layers, cfg.head_dim, cfg.num_heads, cfg.kv_heads
+
+    def split_qkv(i):
+        w = sd[f"h.{i}.self_attention.query_key_value.weight"]  # (out, h)
+        if get("new_decoder_architecture", False):
+            g = nh // nkv  # heads per kv group: [g q-heads, 1 k, 1 v] each
+            wg = w.reshape(nkv, g + 2, hd, -1)
+            q = wg[:, :g].reshape(nh * hd, -1)
+            k = wg[:, g].reshape(nkv * hd, -1)
+            v = wg[:, g + 1].reshape(nkv * hd, -1)
+        elif get("multi_query", True):
+            q, k, v = (w[:nh * hd], w[nh * hd:(nh + 1) * hd],
+                       w[(nh + 1) * hd:])
+        else:  # per-head [q, k, v] interleave
+            wg = w.reshape(nh, 3, hd, -1)
+            q, k, v = (wg[:, j].reshape(nh * hd, -1) for j in range(3))
+        return (_rope_unpermute(q.T, nh, hd), _rope_unpermute(k.T, nkv, hd),
+                v.T)
+
+    qs, ks, vs = zip(*(split_qkv(i) for i in range(L)))
+
+    dual_ln = "h.0.ln_attn.weight" in sd
+    ln1_key, ln2_key = (("ln_attn", "ln_mlp") if dual_ln
+                        else ("input_layernorm", "input_layernorm"))
+
+    def lnorm(key, suffix):
+        return _stack([sd[f"h.{i}.{key}.{suffix}"] for i in range(L)])
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": sd["word_embeddings.weight"]},
+        "layers": {
+            "attn": {"wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
+                     "wo": _lw(sd, "h.{}.self_attention.dense.weight", L)},
+            "ln1": {"scale": lnorm(ln1_key, "weight"),
+                    "bias": lnorm(ln1_key, "bias")},
+            "ln2": {"scale": lnorm(ln2_key, "weight"),
+                    "bias": lnorm(ln2_key, "bias")},
+            "mlp": {"w_in": _lw(sd, "h.{}.mlp.dense_h_to_4h.weight", L),
+                    "w_out": _lw(sd, "h.{}.mlp.dense_4h_to_h.weight", L)},
+        },
+        "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+    if not cfg.tie_embeddings and "lm_head.weight" in sd:
+        params["lm_head"] = {"w": sd["lm_head.weight"].T}
+    return params
+
+
+def params_from_hf_gpt_neox(state_dict: Dict[str, Any],
+                            cfg: tfm.TransformerConfig) -> Dict[str, Any]:
+    """GPT-NeoX / Pythia: per-head-fused QKV ([q,k,v] per head), partial
+    rotary, parallel residual, biases throughout."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, hd, nh = cfg.num_layers, cfg.head_dim, cfg.num_heads
+    rot = cfg.rot_dim
+
+    def split_qkv(i):
+        w = sd[f"gpt_neox.layers.{i}.attention.query_key_value.weight"]
+        b = sd[f"gpt_neox.layers.{i}.attention.query_key_value.bias"]
+        wg = w.reshape(nh, 3, hd, -1)
+        bg = b.reshape(nh, 3, hd)
+        out = []
+        for j in range(3):
+            wj = wg[:, j].reshape(nh * hd, -1).T
+            bj = bg[:, j].reshape(nh * hd)
+            if j < 2:  # q, k rotate
+                wj = _rope_unpermute(wj, nh, hd, rot)
+                bj = _rope_unpermute_bias(bj, nh, hd, rot)
+            out.append((wj, bj))
+        return out
+
+    per_layer = [split_qkv(i) for i in range(L)]
+    lb = lambda pattern: _lnorm(sd, pattern, L)
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": sd["gpt_neox.embed_in.weight"]},
+        "layers": {
+            "attn": {
+                "wq": _stack([pl[0][0] for pl in per_layer]),
+                "wk": _stack([pl[1][0] for pl in per_layer]),
+                "wv": _stack([pl[2][0] for pl in per_layer]),
+                "wo": _lw(sd, "gpt_neox.layers.{}.attention.dense.weight", L),
+                "bq": _stack([pl[0][1] for pl in per_layer]),
+                "bk": _stack([pl[1][1] for pl in per_layer]),
+                "bv": _stack([pl[2][1] for pl in per_layer]),
+                "bo": lb("gpt_neox.layers.{}.attention.dense.bias"),
+            },
+            "ln1": {"scale": lb("gpt_neox.layers.{}.input_layernorm.weight"),
+                    "bias": lb("gpt_neox.layers.{}.input_layernorm.bias")},
+            "ln2": {"scale": lb(
+                "gpt_neox.layers.{}.post_attention_layernorm.weight"),
+                "bias": lb(
+                    "gpt_neox.layers.{}.post_attention_layernorm.bias")},
+            "mlp": {
+                "w_in": _lw(sd, "gpt_neox.layers.{}.mlp.dense_h_to_4h.weight", L),
+                "w_out": _lw(sd, "gpt_neox.layers.{}.mlp.dense_4h_to_h.weight", L),
+                "b_in": lb("gpt_neox.layers.{}.mlp.dense_h_to_4h.bias"),
+                "b_out": lb("gpt_neox.layers.{}.mlp.dense_4h_to_h.bias"),
+            },
+        },
+        "final_norm": {"scale": sd["gpt_neox.final_layer_norm.weight"],
+                       "bias": sd["gpt_neox.final_layer_norm.bias"]},
+    }
+    if not cfg.tie_embeddings and "embed_out.weight" in sd:
+        params["lm_head"] = {"w": sd["embed_out.weight"].T}
+    return params
+
+
+def params_from_hf_opt(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
+                       ) -> Dict[str, Any]:
+    """OPT: pre-LN decoder with ReLU MLP, biases throughout, and learned
+    positions with the HF offset of 2 baked into the stored table."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L = cfg.num_layers
+    pre = "model.decoder.layers.{}"
+
+    def lw(name):
+        return _stack([sd[(pre + "." + name + ".weight").format(i)].T
+                       for i in range(L)])
+
+    def lb(name, field="bias"):
+        return _stack([sd[(pre + "." + name + "." + field).format(i)]
+                       for i in range(L)])
+
+    params: Dict[str, Any] = {
+        "embed": {
+            "tokens": sd["model.decoder.embed_tokens.weight"],
+            # OPTLearnedPositionalEmbedding looks up position+2
+            "position": sd["model.decoder.embed_positions.weight"][2:],
+        },
+        "layers": {
+            "attn": {
+                "wq": lw("self_attn.q_proj"), "wk": lw("self_attn.k_proj"),
+                "wv": lw("self_attn.v_proj"), "wo": lw("self_attn.out_proj"),
+                "bq": lb("self_attn.q_proj"), "bk": lb("self_attn.k_proj"),
+                "bv": lb("self_attn.v_proj"), "bo": lb("self_attn.out_proj"),
+            },
+            "ln1": {"scale": lb("self_attn_layer_norm", "weight"),
+                    "bias": lb("self_attn_layer_norm")},
+            "ln2": {"scale": lb("final_layer_norm", "weight"),
+                    "bias": lb("final_layer_norm")},
+            "mlp": {"w_in": lw("fc1"), "w_out": lw("fc2"),
+                    "b_in": lb("fc1"), "b_out": lb("fc2")},
+        },
+        "final_norm": {
+            "scale": sd["model.decoder.final_layer_norm.weight"],
+            "bias": sd["model.decoder.final_layer_norm.bias"]},
+    }
+    if not cfg.tie_embeddings and "lm_head.weight" in sd:
+        params["lm_head"] = {"w": sd["lm_head.weight"].T}
+    return params
 
 
 def params_to_hf_llama(params: Dict[str, Any], cfg: tfm.TransformerConfig
@@ -190,6 +564,26 @@ def params_to_hf_llama(params: Dict[str, Any], cfg: tfm.TransformerConfig
     return out
 
 
+# model_type → converter.  The registry the reference keeps as
+# ``module_inject/containers/`` policies + ``replace_module.py`` policy_to_ds
+# dispatch; new architectures register here.
+ARCH_CONVERTERS: Dict[str, Callable] = {
+    "llama": params_from_hf_llama,
+    "mistral": params_from_hf_llama,  # llama schema (+ sliding window cfg)
+    "qwen2": params_from_hf_qwen2,
+    "mixtral": params_from_hf_mixtral,
+    "phi3": params_from_hf_phi3,
+    "falcon": params_from_hf_falcon,
+    "gpt_neox": params_from_hf_gpt_neox,
+    "opt": params_from_hf_opt,
+    "gpt2": params_from_hf_gpt2,
+}
+
+
+def supported_architectures() -> tuple:
+    return tuple(sorted(ARCH_CONVERTERS))
+
+
 def load_hf_model(model_name_or_sd, hf_config=None,
                   ) -> tuple:
     """One-call loader: (TransformerConfig, params).  Accepts a transformers
@@ -204,9 +598,12 @@ def load_hf_model(model_name_or_sd, hf_config=None,
     else:
         sd = model_name_or_sd
     cfg = config_from_hf(hf_config)
-    model_type = (hf_config.get("model_type", "llama")
-                  if isinstance(hf_config, dict)
-                  else getattr(hf_config, "model_type", "llama"))
-    if model_type == "gpt2":
-        return cfg, params_from_hf_gpt2(sd, cfg)
-    return cfg, params_from_hf_llama(sd, cfg)
+    model_type = _getter(hf_config)("model_type", "llama")
+    convert = ARCH_CONVERTERS.get(model_type)
+    if convert is None:
+        raise ValueError(
+            f"unsupported HF model_type {model_type!r}; supported: "
+            f"{supported_architectures()}")
+    if convert is params_from_hf_falcon:
+        return cfg, convert(sd, cfg, hf_config)
+    return cfg, convert(sd, cfg)

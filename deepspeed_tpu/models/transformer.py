@@ -40,11 +40,17 @@ class TransformerConfig:
     max_seq_len: int = 2048
     # architecture switches
     norm: str = "rmsnorm"  # rmsnorm (llama) | layernorm (gpt2)
-    activation: str = "silu"  # silu => SwiGLU (llama); gelu => GELU MLP (gpt2)
+    activation: str = "silu"  # silu => SwiGLU; gelu => GELU MLP; relu (opt)
     position: str = "rope"  # rope (llama) | learned (gpt2)
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
+    # parallel attention+MLP residual (falcon/gpt-neox/phi-2):
+    #   h = h + attn(ln1(h)) + mlp(ln2(h))
+    # (models sharing one layernorm duplicate it into ln1/ln2 on conversion)
+    parallel_residual: bool = False
+    # rotate only the first fraction of each head's dims (gpt-neox/phi)
+    partial_rotary_factor: float = 1.0
     # sliding-window attention (0 == full); Mistral-style band
     sliding_window: int = 0
     # MoE (0 == dense); see deepspeed_tpu/moe for the layer implementation
@@ -66,6 +72,11 @@ class TransformerConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def rot_dim(self) -> int:
+        """Rotated head dims (partial rotary rounds down to even)."""
+        return int(self.head_dim * self.partial_rotary_factor) // 2 * 2
 
     def flops_per_token(self) -> float:
         """Dense fwd+bwd FLOPs/token ≈ 6N + attention term (PaLM appendix B)."""
@@ -189,9 +200,13 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
     return params
 
 
-def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+def param_axes(cfg: TransformerConfig, params: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
     """Logical-axes pytree matching ``init_params`` output, consumed by
-    sharding rules (the zero.Init / AutoTP annotation surface)."""
+    sharding rules (the zero.Init / AutoTP annotation surface).
+
+    Pass ``params`` for HF-converted trees that carry linear biases
+    (qwen2/opt/gpt-neox …): bias leaves get matching axes entries."""
     ln = {"scale": ("layers", "embed")}
     if cfg.norm == "layernorm":
         ln = {"scale": ("layers", "embed"), "bias": ("layers", "embed")}
@@ -232,6 +247,19 @@ def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
         axes["embed"]["position"] = ("seq", "embed")
     if not cfg.tie_embeddings:
         axes["lm_head"] = {"w": ("embed", "vocab")}
+
+    if params is not None:  # add axes for optional bias leaves
+        bias_axes = {
+            "bq": ("layers", "heads"), "bk": ("layers", "kv_heads"),
+            "bv": ("layers", "kv_heads"), "bo": ("layers", "embed"),
+            "b_gate": ("layers", "mlp"), "b_in": ("layers", "mlp"),
+            "b_out": ("layers", "embed"),
+        }
+        for blk in ("attn", "mlp"):
+            have = params.get("layers", {}).get(blk, {})
+            for key, ax in bias_axes.items():
+                if key in have and key not in layer.get(blk, {}):
+                    layer.setdefault(blk, {})[key] = ax
     return axes
 
 
@@ -260,13 +288,22 @@ def rope_table(seq_len: int, head_dim: int, theta: float) -> Tuple[jax.Array, ja
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     """x: (B, S, H, D). Rotates pairs (even, odd) of the head dim.
-    (TPU-equivalent of the reference's ``apply_rotary_pos_emb.cu``.)"""
-    x1, x2 = x[..., ::2], x[..., 1::2]
+    (TPU-equivalent of the reference's ``apply_rotary_pos_emb.cu``.)
+
+    Partial rotary (gpt-neox/phi): when the table covers fewer than D/2
+    frequencies, only the first 2*len(freqs) dims rotate; the rest pass
+    through unchanged."""
+    rot = 2 * cos.shape[-1]
+    xr = x[..., :rot]
+    x1, x2 = xr[..., ::2], xr[..., 1::2]
     c = cos[None, :, None, :].astype(x.dtype)
     s = sin[None, :, None, :].astype(x.dtype)
     o1 = x1 * c - x2 * s
     o2 = x2 * c + x1 * s
-    return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+    out = jnp.stack([o1, o2], axis=-1).reshape(xr.shape)
+    if rot == x.shape[-1]:
+        return out
+    return jnp.concatenate([out, x[..., rot:]], axis=-1)
 
 
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
@@ -319,26 +356,39 @@ def resolve_attention(impl: str) -> AttentionFn:
     raise ValueError(f"unknown attn_impl {impl!r}")
 
 
+def _lin(x, p, w_key, b_key):
+    y = x @ p[w_key].astype(x.dtype)
+    if b_key in p:
+        y = y + p[b_key].astype(x.dtype)
+    return y
+
+
 def _attention_block(x, p, cfg: TransformerConfig, cos, sin, attn_fn: AttentionFn):
     B, S, h = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     dt = x.dtype
-    q = (x @ p["wq"].astype(dt)).reshape(B, S, nh, hd)
-    k = (x @ p["wk"].astype(dt)).reshape(B, S, nkv, hd)
-    v = (x @ p["wv"].astype(dt)).reshape(B, S, nkv, hd)
+    q = _lin(x, p, "wq", "bq").reshape(B, S, nh, hd)
+    k = _lin(x, p, "wk", "bk").reshape(B, S, nkv, hd)
+    v = _lin(x, p, "wv", "bv").reshape(B, S, nkv, hd)
     if cfg.position == "rope":
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
     o = attn_fn(q, k, v, causal=True)
-    return o.reshape(B, S, nh * hd) @ p["wo"].astype(dt)
+    return _lin(o.reshape(B, S, nh * hd), p, "wo", "bo")
 
 
 def _mlp_block(x, p, cfg: TransformerConfig):
-    dt = x.dtype
     if cfg.activation == "silu":
-        return (jax.nn.silu(x @ p["w_gate"].astype(dt)) * (x @ p["w_in"].astype(dt))) \
-            @ p["w_out"].astype(dt)
-    return jax.nn.gelu(x @ p["w_in"].astype(dt), approximate=True) @ p["w_out"].astype(dt)
+        return _lin(jax.nn.silu(_lin(x, p, "w_gate", "b_gate"))
+                    * _lin(x, p, "w_in", "b_in"), p, "w_out", "b_out")
+    mid = _lin(x, p, "w_in", "b_in")
+    if cfg.activation == "relu":
+        mid = jax.nn.relu(mid)
+    elif cfg.activation == "gelu_exact":  # erf form (falcon/gpt-neox/phi)
+        mid = jax.nn.gelu(mid, approximate=False)
+    else:  # 'gelu': tanh approximation (gpt2's gelu_new)
+        mid = jax.nn.gelu(mid, approximate=True)
+    return _lin(mid, p, "w_out", "b_out")
 
 
 def _remat_policy(name: str):
@@ -384,7 +434,7 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
         x = x + params["embed"]["position"].astype(dt)[None, :S]
     cos, sin = (None, None)
     if cfg.position == "rope":
-        cos, sin = rope_table(S, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_table(S, cfg.rot_dim, cfg.rope_theta)
 
     from jax.ad_checkpoint import checkpoint_name
 
@@ -393,8 +443,12 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
         a_in = _norm(h, layer_params["ln1"], cfg.norm, cfg.norm_eps)
         attn_out = _attention_block(a_in, layer_params["attn"], cfg, cos, sin,
                                     attn_fn)
-        h = h + checkpoint_name(attn_out, "attn_out")
-        m_in = _norm(h, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+        if cfg.parallel_residual:
+            # falcon/gpt-neox/phi-2: both branches read the SAME input h
+            m_in = _norm(h, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+        else:
+            h = h + checkpoint_name(attn_out, "attn_out")
+            m_in = _norm(h, layer_params["ln2"], cfg.norm, cfg.norm_eps)
         if cfg.num_experts > 0:
             if moe_fn is None:
                 from ..moe.layer import dense_moe_block
@@ -404,7 +458,11 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
                 mlp_out = moe_fn(m_in, layer_params["moe"], cfg)
         else:
             mlp_out = _mlp_block(m_in, layer_params["mlp"], cfg)
-        h = h + checkpoint_name(mlp_out, "mlp_out")
+        if cfg.parallel_residual:
+            h = h + checkpoint_name(attn_out, "attn_out") \
+                + checkpoint_name(mlp_out, "mlp_out")
+        else:
+            h = h + checkpoint_name(mlp_out, "mlp_out")
         return h, None
 
     policy = _remat_policy(cfg.remat_policy)

@@ -214,6 +214,16 @@ class TrainingEngine:
                         f"zero_quantized_gradients cannot combine with {ax} "
                         "parallelism (model-internal collectives cannot nest "
                         "inside the manual dp reduction)")
+        if config.zero_optimization.zero_quantized_weights:
+            if stage < 3:
+                raise ConfigError(
+                    "zero_quantized_weights (qwZ) requires stage 3 — below "
+                    "stage 3 params are replicated and there is no weight "
+                    "all-gather to quantize")
+            if self.offload_enabled:
+                raise ConfigError(
+                    "zero_quantized_weights + offload_optimizer is not "
+                    "supported")
 
         # ---- state init (sharded at construction) ---------------------
         self.opt_shardings = None  # set inside _init_state
@@ -242,6 +252,21 @@ class TrainingEngine:
                  f"micro={self.batch_config.micro_batch_size_per_device} "
                  f"gas={self.batch_config.gradient_accumulation_steps} "
                  f"dtype={self.compute_dtype}")
+        if stage >= 3:
+            rep = self.shard_report()
+            log_dist(
+                f"ZeRO-3 shard accounting: {rep['sharded_fraction']:.1%} of "
+                f"{rep['total_bytes'] / 2**20:.1f} MiB param bytes removed "
+                f"per device ({rep['per_device_bytes'] / 2**20:.1f} MiB local)")
+            fsdp_n = self.topo.size("fsdp")
+            expected = 1.0 - 1.0 / max(fsdp_n, 1)
+            if fsdp_n > 1 and rep["sharded_fraction"] < 0.5 * expected:
+                logger.warning(
+                    "ZeRO-3 is sharding only %.1f%% of param bytes (expected "
+                    "~%.1f%% at fsdp=%d) — large replicated leaves: %s. "
+                    "Check logical-axes annotations / dim divisibility.",
+                    100 * rep["sharded_fraction"], 100 * expected, fsdp_n,
+                    rep["replicated_leaves"][:5])
 
     # ------------------------------------------------------------------
     # setup helpers
@@ -316,8 +341,17 @@ class TrainingEngine:
         dynamic = cfg.fp16.dynamic_loss_scale if fp16 else False
         opt_param_shardings = self.opt_param_shardings
 
+        qwz = cfg.zero_optimization.zero_quantized_weights
+        param_shardings = self.param_shardings
+        topo = self.topo
+
         def microbatch_grads(params, mb, rng, ls_state):
             def scaled_loss(p):
+                if qwz:
+                    # ZeRO++ qwZ: stage-3 gathers ship int8 codes + scales
+                    from .zero.qwz import qwz_gather_tree
+
+                    p = qwz_gather_tree(p, param_shardings, topo)
                 loss, metrics = loss_fn(p, mb, rng)
                 return scale_loss(loss, ls_state) if fp16 else loss, metrics
 
@@ -596,6 +630,12 @@ class TrainingEngine:
             log_dist(f"step={self.global_steps} loss={out.get('loss', float('nan')):.4f} "
                      f"lr={out['lr']:.2e} grad_norm={out.get('grad_norm', 0.0):.3f}")
         return out
+
+    def shard_report(self) -> Dict[str, Any]:
+        """Per-param sharded-byte accounting (see zero.sharding.shard_accounting)."""
+        from .zero.sharding import shard_accounting
+
+        return shard_accounting(self.state.params, self.param_shardings)
 
     def eval_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         placed = self._place_batch(batch)

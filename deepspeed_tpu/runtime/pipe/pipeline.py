@@ -66,14 +66,18 @@ def _stage_fn(layer_params, x, cfg, attn_fn, cos, sin):
 
     def body(h, lp):
         a_in = tfm._norm(h, lp["ln1"], cfg.norm, cfg.norm_eps)
-        h = h + tfm._attention_block(a_in, lp["attn"], cfg, cos, sin, attn_fn)
-        m_in = tfm._norm(h, lp["ln2"], cfg.norm, cfg.norm_eps)
+        attn_out = tfm._attention_block(a_in, lp["attn"], cfg, cos, sin,
+                                        attn_fn)
+        m_src = h if cfg.parallel_residual else h + attn_out
+        m_in = tfm._norm(m_src, lp["ln2"], cfg.norm, cfg.norm_eps)
         if cfg.num_experts > 0:
             from ...moe.layer import dense_moe_block
 
-            h = h + dense_moe_block(m_in, lp["moe"], cfg)
+            mlp_out = dense_moe_block(m_in, lp["moe"], cfg)
         else:
-            h = h + tfm._mlp_block(m_in, lp["mlp"], cfg)
+            mlp_out = tfm._mlp_block(m_in, lp["mlp"], cfg)
+        h = (h + attn_out + mlp_out) if cfg.parallel_residual \
+            else (m_src + mlp_out)
         return h, None
 
     policy = tfm._remat_policy(cfg.remat_policy)
@@ -99,7 +103,7 @@ def pipeline_apply(layer_params: Dict[str, Any], x: jax.Array, cfg,
     if pp == 1:
         cos, sin = (None, None)
         if cfg.position == "rope":
-            cos, sin = tfm.rope_table(x.shape[1], cfg.head_dim, cfg.rope_theta)
+            cos, sin = tfm.rope_table(x.shape[1], cfg.rot_dim, cfg.rope_theta)
         return _stage_fn(layer_params, x, cfg, attn_fn, cos, sin)
 
     B, S, H = x.shape
@@ -117,7 +121,7 @@ def pipeline_apply(layer_params: Dict[str, Any], x: jax.Array, cfg,
 
     cos, sin = (None, None)
     if cfg.position == "rope":
-        cos, sin = tfm.rope_table(S, cfg.head_dim, cfg.rope_theta)
+        cos, sin = tfm.rope_table(S, cfg.rot_dim, cfg.rope_theta)
 
     def local(layer_params, x):
         me = lax.axis_index("pp")
@@ -235,7 +239,7 @@ def _run_1f1b(layer_params, head_params, x, labels, mask, cfg, M, attn_fn,
     B, S, H = x.shape
     cos, sin = (None, None)
     if cfg.position == "rope":
-        cos, sin = tfm.rope_table(S, cfg.head_dim, cfg.rope_theta)
+        cos, sin = tfm.rope_table(S, cfg.rot_dim, cfg.rope_theta)
 
     def stage(lp, xin):
         return _stage_fn(lp, xin, cfg, attn_fn, cos, sin)

@@ -50,9 +50,15 @@ LogicalAxes = Optional[Tuple[Optional[str], ...]]
 
 @dataclasses.dataclass(frozen=True)
 class ShardingRules:
-    """logical axis name → mesh axis name(s) (None = replicate)."""
+    """logical axis name → mesh axis name(s) (None = replicate).
+
+    ``fsdp_fallback`` (stage ≥ 3): when the preferred shard axis is absent or
+    indivisible on a leaf, place ``fsdp`` on the largest divisible unsharded
+    dim instead of silently replicating — the GSPMD analogue of stage-3's
+    flatten-and-split universality (``stage3.py:830``)."""
 
     rules: Dict[str, Optional[Tuple[str, ...]]]
+    fsdp_fallback: bool = False
 
     def mesh_axes_for(self, logical: Optional[str]) -> Optional[Tuple[str, ...]]:
         if logical is None:
@@ -63,7 +69,7 @@ class ShardingRules:
         new = dict(self.rules)
         for k, v in kv.items():
             new[k] = tuple(v) if v is not None else None
-        return ShardingRules(new)
+        return ShardingRules(new, self.fsdp_fallback)
 
 
 def default_rules(stage: int, topo: MeshTopology, shard_axis: str = "embed") -> ShardingRules:
@@ -92,6 +98,7 @@ def default_rules(stage: int, topo: MeshTopology, shard_axis: str = "embed") -> 
     }
     if stage >= 3:
         rules[shard_axis] = ("fsdp",)
+        return ShardingRules(rules, fsdp_fallback=True)
     return ShardingRules(rules)
 
 
@@ -138,6 +145,14 @@ def _spec_for(shape: Tuple[int, ...], axes: LogicalAxes, rules: ShardingRules,
             continue
         used.update(mesh_axes)
         spec.append(mesh_axes if len(mesh_axes) > 1 else mesh_axes[0])
+
+    if rules.fsdp_fallback and "fsdp" not in used:
+        n = topo.size("fsdp")
+        cands = [i for i, (d, e) in enumerate(zip(shape, spec))
+                 if e is None and d >= n and d % n == 0]
+        if n > 1 and cands:
+            best = max(cands, key=lambda i: shape[i])
+            spec[best] = "fsdp"
     return P(*spec)
 
 
@@ -169,6 +184,32 @@ def sharding_for_tree(tree_shapes: Any, tree_axes: Any, rules: ShardingRules,
     return jax.tree.map(
         lambda axes, subtree: jax.tree.map(lambda leaf: one(leaf, axes), subtree),
         tree_axes, tree_shapes, is_leaf=_is_axes_leaf)
+
+
+def shard_accounting(params: Any, shardings: Any) -> Dict[str, Any]:
+    """Measure how much of the param bytes ZeRO sharding actually removes.
+
+    Returns total bytes, per-device bytes, ``sharded_fraction``
+    (1 - per_device/total; 0 = fully replicated) and the paths of replicated
+    leaves ≥ 1 MiB — the accounting surface the reference's partition
+    machinery gets for free by construction and GSPMD needs made explicit.
+    """
+    total = 0
+    per_device = 0
+    replicated_big = []
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    shard_leaves = jax.tree_util.tree_leaves(shardings)
+    for (path, leaf), sh in zip(leaves, shard_leaves):
+        nbytes = int(leaf.size) * leaf.dtype.itemsize
+        local = int(np.prod(sh.shard_shape(tuple(leaf.shape)))) \
+            * leaf.dtype.itemsize
+        total += nbytes
+        per_device += local
+        if local == nbytes and nbytes >= 1 << 20:
+            replicated_big.append(jax.tree_util.keystr(path))
+    frac = 1.0 - (per_device / total) if total else 0.0
+    return {"total_bytes": total, "per_device_bytes": per_device,
+            "sharded_fraction": frac, "replicated_leaves": replicated_big}
 
 
 def shard_pytree(tree: Any, tree_axes: Any, rules: ShardingRules,

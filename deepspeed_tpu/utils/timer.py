@@ -131,7 +131,16 @@ class SynchronizedWallClockTimer:
 
 
 class ThroughputTimer:
-    """Tracks samples/sec and (given a FLOPs estimate) TFLOPS per device."""
+    """Tracks samples/sec and (given a FLOPs estimate) TFLOPS per device.
+
+    Timing is CUMULATIVE (first start → latest stop) rather than a sum of
+    per-step windows: with async dispatch a step's compute often completes
+    outside the train_batch call (e.g. while the caller reads the returned
+    metrics), so window sums would measure dispatch latency, not throughput.
+    The cumulative clock charges that time to the run no matter where the
+    drain happens.  Per-step hard syncs are opt-in (wall_clock_breakdown) —
+    draining the queue every step defeats the async pipeline.
+    """
 
     def __init__(self, batch_size: int, start_step: int = 2,
                  steps_per_output: Optional[int] = None, monitor_memory: bool = False,
@@ -140,17 +149,13 @@ class ThroughputTimer:
         self.start_step = start_step
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
-        # Hard-draining the device queue on every step defeats async dispatch
-        # (H2D copies and host dispatch stop overlapping with compute), so
-        # per-step sync is opt-in (wall_clock_breakdown); aggregate
-        # samples/sec stays accurate because the dispatch queue depth is
-        # bounded and drains amortize over many steps.
         self.synchronize = synchronize
         self.epoch_count = 0
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
-        self.step_elapsed_time = 0.0
-        self._started: Optional[float] = None
+        self._first_start: Optional[float] = None
+        self._period_start: Optional[float] = None
+        self._period_steps = 0
         self.started_ = False
 
     def update_epoch_count(self) -> None:
@@ -161,7 +166,11 @@ class ThroughputTimer:
         if self.global_step_count >= self.start_step:
             if self.synchronize:
                 _device_sync()
-            self._started = time.perf_counter()
+            now = time.perf_counter()
+            if self._first_start is None:
+                self._first_start = now
+            if self._period_start is None:
+                self._period_start = now
 
     def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
         if not self.started_:
@@ -169,21 +178,24 @@ class ThroughputTimer:
         self.started_ = False
         if global_step:
             self.global_step_count += 1
-        if self._started is not None:
+        if self._first_start is not None:
             if self.synchronize:
                 _device_sync()
-            duration = time.perf_counter() - self._started
-            self._started = None
-            self.total_elapsed_time += duration
-            self.step_elapsed_time += duration
+            now = time.perf_counter()
+            self.total_elapsed_time = now - self._first_start
+            if global_step:
+                self._period_steps += 1
             if global_step and report_speed and self.steps_per_output and \
                     self.global_step_count % self.steps_per_output == 0:
+                period = now - (self._period_start or now)
+                steps = max(1, self._period_steps)
                 log_dist(
                     f"epoch={self.epoch_count}/step={self.global_step_count}, "
                     f"throughput={self.avg_samples_per_sec():.2f} samples/s, "
-                    f"latency={self.step_elapsed_time / self.steps_per_output:.3f} s",
+                    f"latency={period / steps:.3f} s",
                 )
-                self.step_elapsed_time = 0.0
+                self._period_start = now
+                self._period_steps = 0
 
     def avg_samples_per_sec(self) -> float:
         timed_steps = max(1, self.global_step_count - self.start_step)

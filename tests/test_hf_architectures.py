@@ -1,0 +1,188 @@
+"""Golden-logits tests for the HF architecture map (AutoTP model policies).
+
+Reference role: ``module_inject/containers/`` (one policy per architecture)
+and ``inference/v2/model_implementations/`` — each supported model_type must
+reproduce transformers' own forward exactly (fp32) through
+``load_hf_model`` → ``tfm.forward``.  Random-init tiny configs; no downloads.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+
+from deepspeed_tpu.models import transformer as tfm  # noqa: E402
+from deepspeed_tpu.models.hf_integration import (  # noqa: E402
+    load_hf_model, supported_architectures)
+
+
+def _golden(hf_cfg, cfg_overrides=None, atol=3e-4, rtol=3e-3, seq=16):
+    from transformers import AutoModelForCausalLM
+
+    torch.manual_seed(0)
+    hf = AutoModelForCausalLM.from_config(
+        hf_cfg, attn_implementation="eager").eval()
+    cfg, params = load_hf_model(hf)
+    over = {"dtype": "float32", "param_dtype": "float32"}
+    over.update(cfg_overrides or {})
+    cfg = tfm.TransformerConfig(**{**cfg.__dict__, **over})
+    toks = np.random.default_rng(0).integers(
+        0, hf_cfg.vocab_size, (2, seq)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks.astype(np.int64))).logits.numpy()
+    ours = np.asarray(tfm.forward(params, toks, cfg))
+    np.testing.assert_allclose(ours, ref, atol=atol, rtol=rtol)
+    return cfg, params
+
+
+def test_mistral_golden(devices):
+    from transformers import MistralConfig
+
+    _golden(MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=None,
+        tie_word_embeddings=False))
+
+
+def test_qwen2_golden(devices):
+    from transformers import Qwen2Config
+
+    cfg, params = _golden(Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True))
+    assert "bq" in params["layers"]["attn"]  # qkv biases carried through
+
+
+def test_mixtral_golden(devices):
+    from transformers import MixtralConfig
+
+    _golden(MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, tie_word_embeddings=False),
+        # capacity ≥ worst-case routing so the capacity-bucketed dispatch
+        # is exact (HF's reference block is dropless)
+        cfg_overrides={"moe_capacity_factor": 4.0})
+
+
+def test_phi3_golden(devices):
+    Phi3Config = pytest.importorskip("transformers").Phi3Config
+
+    _golden(Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        pad_token_id=0))
+
+
+def test_falcon_multiquery_golden(devices):
+    from transformers import FalconConfig
+
+    _golden(FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True,
+        new_decoder_architecture=False, parallel_attn=True, bias=False,
+        alibi=False, tie_word_embeddings=True))
+
+
+def test_falcon_new_arch_golden(devices):
+    from transformers import FalconConfig
+
+    _golden(FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2,
+        new_decoder_architecture=True, bias=False, alibi=False,
+        tie_word_embeddings=True))
+
+
+def test_gpt_neox_golden(devices):
+    from transformers import GPTNeoXConfig
+
+    cfg, _ = _golden(GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.25,
+        use_parallel_residual=True, max_position_embeddings=64,
+        tie_word_embeddings=False))
+    assert cfg.parallel_residual and cfg.rot_dim == 4  # 16 * 0.25
+
+
+def test_gpt_neox_nonparallel_golden(devices):
+    from transformers import GPTNeoXConfig
+
+    _golden(GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=1.0,
+        use_parallel_residual=False, max_position_embeddings=64,
+        tie_word_embeddings=False))
+
+
+def test_opt_golden(devices):
+    from transformers import OPTConfig
+
+    _golden(OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=256, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=True, word_embed_proj_dim=64))
+
+
+@pytest.mark.parametrize("arch", ["qwen2", "gpt_neox", "opt"])
+def test_converted_models_serve_through_inference_v1(devices, arch):
+    """The KV-cache inference engine must honor the new architecture features
+    (projection biases, parallel residual, partial rotary, learned offset
+    positions): greedy decode == uncached forward argmax."""
+    import deepspeed_tpu
+    from transformers import AutoModelForCausalLM
+
+    if arch == "qwen2":
+        from transformers import Qwen2Config
+        hf_cfg = Qwen2Config(vocab_size=128, hidden_size=64,
+                             intermediate_size=128, num_hidden_layers=2,
+                             num_attention_heads=4, num_key_value_heads=2,
+                             max_position_embeddings=64)
+    elif arch == "gpt_neox":
+        from transformers import GPTNeoXConfig
+        hf_cfg = GPTNeoXConfig(vocab_size=128, hidden_size=64,
+                               intermediate_size=256, num_hidden_layers=2,
+                               num_attention_heads=4, rotary_pct=0.25,
+                               use_parallel_residual=True,
+                               max_position_embeddings=64)
+    else:
+        from transformers import OPTConfig
+        hf_cfg = OPTConfig(vocab_size=128, hidden_size=64, ffn_dim=256,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           max_position_embeddings=64,
+                           do_layer_norm_before=True, word_embed_proj_dim=64)
+    torch.manual_seed(0)
+    hf = AutoModelForCausalLM.from_config(
+        hf_cfg, attn_implementation="eager").eval()
+    cfg, params = load_hf_model(hf)
+    cfg = tfm.TransformerConfig(**{**cfg.__dict__, "dtype": "float32",
+                                   "param_dtype": "float32"})
+    engine = deepspeed_tpu.init_inference(
+        config={"max_seq_len": 32}, model_config=cfg, params=params)
+    prompt = np.array([[5, 6, 7, 8]], np.int32)
+    out = engine.generate(prompt, max_new_tokens=5, temperature=0.0)
+    seq = prompt.copy()
+    for t in range(5):
+        nxt = np.asarray(tfm.forward(params, seq, cfg)[:, -1]
+                         .argmax(-1)).astype(np.int32)
+        assert nxt[0] == out[0, 4 + t], f"{arch} divergence at step {t}"
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+
+
+def test_unsupported_arch_rejected(devices):
+    with pytest.raises(ValueError, match="unsupported HF model_type"):
+        load_hf_model({"fake.weight": np.zeros((2, 2))},
+                      {"model_type": "bert"})
+
+
+def test_supported_architectures_surface(devices):
+    archs = supported_architectures()
+    for required in ("llama", "mistral", "mixtral", "qwen2", "phi3",
+                     "falcon", "gpt_neox", "opt", "gpt2"):
+        assert required in archs, archs

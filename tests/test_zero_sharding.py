@@ -42,19 +42,27 @@ def test_stage1_optimizer_sharded_params_replicated(topo_dp8):
     assert not os_.is_fully_replicated
 
 
-def test_indivisible_dim_replicates(topo_fsdp8):
+def test_indivisible_dim_falls_back_then_replicates(topo_fsdp8):
     rules = zs.rules_for_params(3, topo_fsdp8)
+    # 15 % 8 != 0 on the preferred embed dim → fsdp falls back to the 32 dim
     s = zs.logical_to_sharding((15, 32), ("embed", "mlp"), rules, topo_fsdp8)
-    assert s.is_fully_replicated  # 15 % 8 != 0 → fall back, don't crash
+    assert not s.is_fully_replicated
+    assert "fsdp" in jax.tree_util.tree_leaves(tuple(s.spec))
+    # nothing divisible anywhere → replicate, don't crash
+    s2 = zs.logical_to_sharding((15, 9), ("embed", "mlp"), rules, topo_fsdp8)
+    assert s2.is_fully_replicated
 
 
 def test_shard_pytree_places_leaves(topo_fsdp8):
-    tree = {"w": jnp.ones((16, 8)), "b": jnp.ones((8,))}
-    axes = {"w": ("embed", "mlp"), "b": (None,)}
+    tree = {"w": jnp.ones((16, 8)), "b": jnp.ones((8,)), "r": jnp.ones((8,))}
+    # (None,) dims are fallback-shardable at stage 3 (flatten-and-split
+    # universality); a whole-leaf None opts out entirely
+    axes = {"w": ("embed", "mlp"), "b": (None,), "r": None}
     rules = zs.rules_for_params(3, topo_fsdp8)
     out = zs.shard_pytree(tree, axes, rules, topo_fsdp8)
     assert not out["w"].sharding.is_fully_replicated
-    assert out["b"].sharding.is_fully_replicated
+    assert not out["b"].sharding.is_fully_replicated
+    assert out["r"].sharding.is_fully_replicated
     np.testing.assert_allclose(np.asarray(out["w"]), np.ones((16, 8)))
 
 
@@ -87,3 +95,44 @@ def test_sharding_for_tree_prefix_broadcast(topo_fsdp8):
     # None prefix replicates everything
     out2 = zs.sharding_for_tree(tree, None, rules, topo_fsdp8)
     assert out2["a"]["w"].is_fully_replicated
+
+
+def test_stage3_fallback_shard_axis(devices):
+    """A leaf whose preferred (embed) dim is indivisible gets fsdp on another
+    divisible dim instead of silently replicating (stage3 flatten-and-split
+    universality, stage3.py:830)."""
+    from deepspeed_tpu.parallel.topology import MeshTopology
+    from deepspeed_tpu.runtime.config import MeshConfig
+    from deepspeed_tpu.runtime.zero.sharding import (default_rules,
+                                                     logical_to_sharding)
+
+    topo = MeshTopology.from_config(MeshConfig(fsdp_size=8))
+    rules = default_rules(3, topo)
+    # hidden=60 not divisible by 8; the 64-sized heads dim is
+    sh = logical_to_sharding((4, 60, 64), ("layers", "embed", "heads"),
+                             rules, topo)
+    assert "fsdp" in jax.tree_util.tree_leaves(tuple(sh.spec)), sh.spec
+    # stage<3 rules must NOT grow a fallback
+    sh2 = logical_to_sharding((4, 60, 64), ("layers", "embed", "heads"),
+                             default_rules(1, topo), topo)
+    assert "fsdp" not in jax.tree_util.tree_leaves(tuple(sh2.spec))
+
+
+def test_stage3_shard_accounting_report(devices):
+    """Engine reports ≥ 80% of param bytes sharded for a divisible model at
+    fsdp=8, and the report surface exposes replicated leaves."""
+    import deepspeed_tpu
+    from tests.simple_model import tiny_lm_spec
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(), config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3},
+            "steps_per_print": 100,
+        })
+    rep = engine.shard_report()
+    expected = 1.0 - 1.0 / 8
+    assert rep["sharded_fraction"] >= 0.8 * expected, rep
+    assert rep["per_device_bytes"] < rep["total_bytes"]
+    assert isinstance(rep["replicated_leaves"], list)
