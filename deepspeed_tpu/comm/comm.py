@@ -72,6 +72,32 @@ def init_distributed(dist_backend: Optional[str] = None,
         num_processes = int(os.environ["NUM_PROCESSES"])
     if process_id is None and "PROCESS_ID" in os.environ:
         process_id = int(os.environ["PROCESS_ID"])
+    if process_id is None:
+        # launcher-backend rank sources (reference: multinode_runner backends
+        # hand rank through their own fabric): Slurm srun, Open MPI, hydra
+        # (MPICH/IMPI) — and pdsh, which can only broadcast one command, so
+        # rank = index of this host in DSTPU_HOSTS
+        for var in ("SLURM_PROCID", "OMPI_COMM_WORLD_RANK", "PMI_RANK"):
+            if var in os.environ:
+                process_id = int(os.environ[var])
+                break
+        else:
+            if "DSTPU_HOSTS" in os.environ:
+                import socket
+
+                names = os.environ["DSTPU_HOSTS"].split(",")
+                hostname = socket.gethostname()
+                short = hostname.split(".")[0]
+                for i, h in enumerate(names):
+                    if h in (hostname, short) or h.split(".")[0] == short:
+                        process_id = i
+                        break
+                else:
+                    raise RuntimeError(
+                        f"cannot derive PROCESS_ID: hostname {hostname!r} "
+                        f"matches no entry of DSTPU_HOSTS={names} — use "
+                        f"resolvable hostnames in the host list (IPs and ssh "
+                        f"aliases cannot be matched) or export PROCESS_ID")
 
     want_multiprocess = (coordinator_address is not None
                          or os.environ.get("DSTPU_MULTIPROCESS", "0") == "1")

@@ -1,0 +1,190 @@
+"""Elastic agent: supervise workers, re-rendezvous on membership change.
+
+Capability analogue of the reference's ``elasticity/elastic_agent.py:32``
+(``DSElasticAgent`` on torchelastic): a coordinator-led supervision loop that
+
+* launches one worker process per current member with the rendezvous env
+  (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID / DSTPU_RESTART_COUNT),
+* watches for worker failure or a membership change (a pluggable
+  ``members_fn`` — cluster metadata, a file, or a scheduler callback),
+* on either event kills the group, recomputes a VALID world size with the
+  elasticity batch math (``compute_elastic_config`` — same config keys as the
+  reference's ``elasticity`` block), and relaunches; workers resume from
+  their latest checkpoint (universal checkpoints reshard on load, so the new
+  world size Just Works).
+
+torchelastic's store/barrier machinery is unnecessary: JAX's coordinator
+service performs the rendezvous; the agent only has to decide WHO is in the
+job and restart the group atomically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..utils.logging import logger
+from .elasticity import ElasticityConfig, compute_elastic_config
+
+
+@dataclasses.dataclass
+class AgentConfig:
+    max_restarts: int = 10
+    poll_interval_s: float = 1.0
+    coordinator_port: int = 8476
+    #: grace period between SIGTERM and SIGKILL when tearing a group down
+    term_timeout_s: float = 10.0
+
+
+class ElasticAgent:
+    """Supervises one worker per member; restarts the group on change.
+
+    ``launch_fn(member, env) -> subprocess.Popen`` defaults to spawning
+    ``program`` locally (unit tests / single host); pod deployments pass a
+    runner-backed launcher (ssh/srun) instead.
+    """
+
+    def __init__(self, program: Sequence[str],
+                 members_fn: Callable[[], List[str]],
+                 elastic_config: Optional[ElasticityConfig] = None,
+                 agent_config: Optional[AgentConfig] = None,
+                 launch_fn: Optional[Callable] = None,
+                 env: Optional[Dict[str, str]] = None):
+        self.program = list(program)
+        self.members_fn = members_fn
+        self.elastic_config = elastic_config
+        self.cfg = agent_config or AgentConfig()
+        self.launch_fn = launch_fn or self._local_launch
+        self.base_env = dict(env or {})
+        self.restart_count = 0
+        self.procs: List[subprocess.Popen] = []
+        self.current_members: List[str] = []
+
+    # -- world sizing ---------------------------------------------------
+
+    def admitted_members(self, members: List[str]) -> List[str]:
+        """Trim membership to the largest VALID world size (elastic batch
+        math); with no elasticity config any size is valid."""
+        if self.elastic_config is None or not members:
+            return members
+        from ..runtime.config_utils import ConfigError
+
+        cfg = self.elastic_config.model_copy(
+            update={"max_device_count": len(members)})
+        try:
+            _, valid_counts, _ = compute_elastic_config(cfg)
+        except ConfigError:
+            return []
+        valid = [n for n in valid_counts if n <= len(members)]
+        if not valid:
+            return []
+        return members[:max(valid)]
+
+    # -- process control ------------------------------------------------
+
+    def _local_launch(self, member: str, env: Dict[str, str]
+                      ) -> subprocess.Popen:
+        import os
+
+        full = dict(os.environ)
+        full.update(env)
+        return subprocess.Popen(self.program, env=full)
+
+    def _start_group(self, members: List[str]) -> None:
+        coordinator = members[0]
+        n = len(members)
+        self.procs = []
+        for pid, member in enumerate(members):
+            env = dict(self.base_env)
+            env.update({
+                "COORDINATOR_ADDRESS":
+                    f"{coordinator}:{self.cfg.coordinator_port}",
+                "NUM_PROCESSES": str(n),
+                "PROCESS_ID": str(pid),
+                "DSTPU_RESTART_COUNT": str(self.restart_count),
+                "DSTPU_ELASTIC_MEMBER": member,
+            })
+            self.procs.append(self.launch_fn(member, env))
+        self.current_members = list(members)
+        logger.info(f"elastic agent: started {n} workers "
+                    f"(restart {self.restart_count}): {members}")
+
+    def _stop_group(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + self.cfg.term_timeout_s
+        for p in self.procs:
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        self.procs = []
+
+    # -- the supervision loop -------------------------------------------
+
+    def run(self) -> int:
+        """Supervise until the group exits cleanly, membership shrinks to
+        nothing, or max_restarts is exhausted.  Returns the final group rc."""
+        members = self.admitted_members(self.members_fn())
+        if not members:
+            raise RuntimeError("elastic agent: no admissible members")
+        self._start_group(members)
+        while True:
+            time.sleep(self.cfg.poll_interval_s)
+
+            rcs = [p.poll() for p in self.procs]
+            all_done = all(rc is not None for rc in rcs)
+            any_failed = any(rc not in (None, 0) for rc in rcs)
+            if all_done and not any_failed:
+                logger.info("elastic agent: group completed cleanly")
+                return 0
+
+            new_members = self.admitted_members(self.members_fn())
+            membership_changed = new_members != self.current_members
+
+            if any_failed or membership_changed:
+                reason = ("worker failure" if any_failed
+                          else f"membership change → {new_members}")
+                logger.warning(f"elastic agent: re-rendezvous ({reason})")
+                self._stop_group()
+                if self.restart_count >= self.cfg.max_restarts:
+                    logger.error("elastic agent: max_restarts exhausted")
+                    return 1
+                self.restart_count += 1
+                # failed member drops out of the next rendezvous
+                if any_failed and not membership_changed:
+                    failed = [m for m, rc in zip(self.current_members, rcs)
+                              if rc not in (None, 0)]
+                    new_members = [m for m in self.current_members
+                                   if m not in failed]
+                    new_members = self.admitted_members(new_members)
+                if not new_members:
+                    logger.error("elastic agent: no admissible members left")
+                    return 1
+                self._start_group(new_members)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="dstpu-elastic")
+    p.add_argument("--hosts", required=True,
+                   help="comma-separated member list (static membership)")
+    p.add_argument("--max_restarts", type=int, default=10)
+    p.add_argument("script")
+    p.add_argument("script_args", nargs="*")
+    args = p.parse_args(argv)
+    program = [sys.executable, args.script, *args.script_args]
+    agent = ElasticAgent(
+        program, members_fn=lambda: args.hosts.split(","),
+        agent_config=AgentConfig(max_restarts=args.max_restarts))
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

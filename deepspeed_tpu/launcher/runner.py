@@ -97,19 +97,25 @@ def build_env(coordinator: str, port: int, num_processes: int, process_id: int,
     return env
 
 
-def _ssh_command(host: str, remote_cmd: str, ssh_args: str = "") -> List[str]:
-    return ["ssh", "-o", "StrictHostKeyChecking=no", *shlex.split(ssh_args),
-            host, remote_cmd]
-
-
 def launch(args: argparse.Namespace) -> int:
+    from .multinode_runner import discover_slurm_hosts, get_runner
+
     # -- resolve hosts -------------------------------------------------
     if args.hostfile and os.path.exists(args.hostfile):
         hosts = parse_hostfile(args.hostfile)
     elif args.hosts:
         hosts = {h: 1 for h in args.hosts.split(",")}
+    elif (slurm_hosts := discover_slurm_hosts()) is not None:
+        # running inside a Slurm allocation: use it (reference runner.py
+        # Slurm resource detection); only auto-pick srun when the user did
+        # not explicitly request a launcher
+        hosts = slurm_hosts
+        if args.launcher is None:
+            args.launcher = "slurm"
     else:
         hosts = {"localhost": 1}
+    if args.launcher is None:
+        args.launcher = "ssh"
     hosts = filter_hosts(hosts, args.include, args.exclude)
     host_list = list(hosts)
     n = len(host_list)
@@ -136,18 +142,39 @@ def launch(args: argparse.Namespace) -> int:
             proc.send_signal(signal.SIGTERM)
             return proc.wait()
 
-    # -- multi host over ssh (PDSH-runner role) ------------------------
+    # -- multi host through the selected backend -----------------------
+    backend_args = args.launcher_args
+    if args.launcher == "ssh" and not backend_args:
+        backend_args = args.ssh_args  # --ssh_args only feeds the ssh backend
+    runner = get_runner(args.launcher, backend_args)
+    if not runner.backend_exists():
+        raise RuntimeError(
+            f"launcher backend {runner.name!r} not available on this host")
     coordinator = host_list[0]
     world_blob = encode_world_info(hosts)
+
+    if runner.single_command:
+        # rank comes from the fabric (SLURM_PROCID / OMPI rank / pdsh
+        # host-index); PROCESS_ID deliberately unset
+        env = build_env(coordinator, args.coordinator_port, n, 0, extra_env)
+        env.pop("PROCESS_ID")
+        env["DSTPU_WORLD_INFO"] = world_blob
+        cmd = runner.get_cmd(env, hosts, script_cmd)
+        logger.info(f"[{runner.name}] {' '.join(cmd)}")
+        proc = subprocess.Popen(
+            cmd, env={**os.environ, **runner.local_env()})
+        try:
+            return proc.wait()
+        except KeyboardInterrupt:
+            proc.send_signal(signal.SIGTERM)
+            return proc.wait()
+
     procs: List[subprocess.Popen] = []
     for pid, host in enumerate(host_list):
         env = build_env(coordinator, args.coordinator_port, n, pid, extra_env)
         env["DSTPU_WORLD_INFO"] = world_blob
-        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-        remote = f"cd {shlex.quote(os.getcwd())} && {exports} " \
-                 f"{' '.join(shlex.quote(c) for c in script_cmd)}"
-        cmd = _ssh_command(host, remote, args.ssh_args)
-        logger.info(f"[{host}] {remote}")
+        cmd = runner.get_per_host_cmd(host, env, script_cmd)
+        logger.info(f"[{host}] {' '.join(cmd[-1:])}")
         procs.append(subprocess.Popen(cmd))
 
     rc = 0
@@ -172,6 +199,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--include", default="", help="comma-separated host allowlist")
     p.add_argument("--exclude", default="", help="comma-separated host denylist")
     p.add_argument("--coordinator_port", type=int, default=DEFAULT_COORDINATOR_PORT)
+    p.add_argument("--launcher", default=None,
+                   choices=["ssh", "pdsh", "openmpi", "mpich", "impi",
+                            "slurm"],
+                   help="multi-node backend (reference --launcher flag); "
+                        "default: slurm inside a Slurm allocation, else ssh")
+    p.add_argument("--launcher_args", default="",
+                   help="extra flags for the backend command")
     p.add_argument("--ssh_args", default="", help="extra ssh flags")
     p.add_argument("--env", action="append", metavar="K=V",
                    help="extra environment for every process")

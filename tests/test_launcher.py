@@ -93,3 +93,159 @@ def test_elastic_config_impossible():
                            max_device_count=2)
     with pytest.raises(ConfigError):
         compute_elastic_config(cfg)
+
+
+# ---------------------------------------------------------------------------
+# multinode runner backends (reference: multinode_runner.py PDSH/MPI/Slurm)
+# ---------------------------------------------------------------------------
+
+
+def test_runner_command_construction():
+    from deepspeed_tpu.launcher.multinode_runner import get_runner
+
+    hosts = {"nodeA": 1, "nodeB": 1}
+    env = {"COORDINATOR_ADDRESS": "nodeA:8476", "NUM_PROCESSES": "2"}
+    prog = ["python", "train.py", "--lr", "1e-4"]
+
+    pdsh = get_runner("pdsh").get_cmd(env, hosts, prog)
+    assert pdsh[0] == "pdsh" and "-w" in pdsh
+    assert pdsh[pdsh.index("-w") + 1] == "nodeA,nodeB"
+    assert "DSTPU_HOSTS=nodeA,nodeB" in pdsh[-1]
+    assert "PDSH_RCMD_TYPE=ssh" in pdsh[-1]
+
+    ompi = get_runner("openmpi").get_cmd(env, hosts, prog)
+    assert ompi[:5] == ["mpirun", "-n", "2", "-npernode", "1"]
+    assert "-x" in ompi and "COORDINATOR_ADDRESS=nodeA:8476" in ompi
+    assert ompi[-4:] == prog
+
+    mpich = get_runner("mpich").get_cmd(env, hosts, prog)
+    assert mpich[:5] == ["mpirun", "-n", "2", "-ppn", "1"]
+    assert "-genv" in mpich and "nodeA,nodeB" in mpich
+
+    impi = get_runner("impi").get_cmd(env, hosts, prog)
+    i = impi.index("-genv")
+    genvs = {impi[j + 1]: impi[j + 2] for j in range(len(impi) - 2)
+             if impi[j] == "-genv"}
+    assert genvs.get("I_MPI_FABRICS") == "shm:ofi"
+
+    slurm = get_runner("slurm").get_cmd(env, hosts, prog)
+    assert slurm[0] == "srun" and "--ntasks-per-node=1" in slurm
+    # env rides an env(1) prefix (argv is comma-safe; --export=K=V is not)
+    assert "--export=ALL" in slurm and "env" in slurm
+    assert "NUM_PROCESSES=2" in slurm
+    assert get_runner("pdsh").local_env() == {"PDSH_RCMD_TYPE": "ssh"}
+
+    ssh = get_runner("ssh")
+    per = ssh.get_per_host_cmd("nodeB", env, prog)
+    assert per[0] == "ssh" and per[-2] == "nodeB"
+    assert "COORDINATOR_ADDRESS=nodeA:8476" in per[-1]
+
+    with pytest.raises(ValueError, match="unknown launcher"):
+        get_runner("kubectl")
+
+
+def test_slurm_nodelist_expansion():
+    from deepspeed_tpu.launcher.multinode_runner import expand_slurm_nodelist
+
+    assert expand_slurm_nodelist("tpu[001-003,007],login1") == \
+        ["tpu001", "tpu002", "tpu003", "tpu007", "login1"]
+    assert expand_slurm_nodelist("single") == ["single"]
+    assert expand_slurm_nodelist("a[1-2],b[10-11]") == \
+        ["a1", "a2", "b10", "b11"]
+
+
+def test_slurm_discovery_from_env(monkeypatch):
+    from deepspeed_tpu.launcher import multinode_runner as mr
+
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "w[01-03]")
+    monkeypatch.setattr(mr.shutil, "which", lambda _: None)
+    assert mr.discover_slurm_hosts() == {"w01": 1, "w02": 1, "w03": 1}
+    monkeypatch.delenv("SLURM_JOB_NODELIST")
+    assert mr.discover_slurm_hosts() is None
+
+
+# ---------------------------------------------------------------------------
+# elastic agent (reference: elasticity/elastic_agent.py DSElasticAgent)
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_agent_restarts_on_worker_failure(tmp_path):
+    """Kill a worker mid-run; the agent re-rendezvouses WITHOUT the failed
+    member and the survivors complete."""
+    import sys
+    from deepspeed_tpu.elasticity.elastic_agent import AgentConfig, ElasticAgent
+
+    marker = tmp_path / "runs"
+    marker.mkdir()
+    script = tmp_path / "worker.py"
+    script.write_text(f"""
+import os, sys, time
+member = os.environ["DSTPU_ELASTIC_MEMBER"]
+restart = os.environ["DSTPU_RESTART_COUNT"]
+n = os.environ["NUM_PROCESSES"]
+open(r"{marker}" + f"/{{member}}-r{{restart}}-n{{n}}", "w").close()
+if member == "hostB" and restart == "0":
+    sys.exit(3)   # simulated hardware failure on first rendezvous
+time.sleep(0.3)
+""")
+    def members_fn():
+        # a health checker would evict the dead host after its crash
+        if (marker / "hostB-r0-n3").exists():
+            return ["hostA", "hostC"]
+        return ["hostA", "hostB", "hostC"]
+
+    agent = ElasticAgent(
+        [sys.executable, str(script)], members_fn=members_fn,
+        agent_config=AgentConfig(max_restarts=3, poll_interval_s=0.1,
+                                 term_timeout_s=2.0))
+    rc = agent.run()
+    assert rc == 0
+    runs = {p.name for p in marker.iterdir()}
+    assert "hostB-r0-n3" in runs            # B ran in the first group
+    assert any(r.startswith("hostA-r") and r.endswith("-n2") for r in runs), \
+        runs                                 # re-rendezvous at world size 2
+    assert any(r.startswith("hostC-r") and r.endswith("-n2") for r in runs)
+    assert not any(r.startswith("hostB-r1") for r in runs)
+    assert agent.restart_count >= 1
+
+
+def test_elastic_agent_membership_change(tmp_path):
+    """Members list shrinking triggers a group restart at the new size,
+    clamped to a VALID world size by the elasticity batch math."""
+    import sys
+    from deepspeed_tpu.elasticity.elastic_agent import AgentConfig, ElasticAgent
+    from deepspeed_tpu.runtime.config import ElasticityConfig
+
+    marker = tmp_path / "runs"
+    marker.mkdir()
+    script = tmp_path / "worker.py"
+    script.write_text(f"""
+import os, time
+m = os.environ["DSTPU_ELASTIC_MEMBER"]
+open(r"{marker}" + "/" + m + "-n" + os.environ["NUM_PROCESSES"]
+     + "-r" + os.environ["DSTPU_RESTART_COUNT"], "w").close()
+time.sleep(1.0)
+""")
+    members = {"value": ["h1", "h2", "h3", "h4"]}
+
+    def members_fn():
+        # h4 leaves once the first group has demonstrably started
+        if (marker / "h4-n4-r0").exists():
+            members["value"] = ["h1", "h2", "h3"]
+        return members["value"]
+
+    # batch math: micro=2, max batch 8 → valid counts {1,2,4} for batch 8;
+    # 3 members must clamp to 2
+    agent = ElasticAgent(
+        [sys.executable, str(script)], members_fn=members_fn,
+        elastic_config=ElasticityConfig(
+            enabled=True, max_train_batch_size=8, micro_batch_sizes=[2],
+            min_device_count=1, max_device_count=4),
+        agent_config=AgentConfig(max_restarts=3, poll_interval_s=0.3,
+                                 term_timeout_s=2.0))
+    rc = agent.run()
+    assert rc == 0
+    runs = {p.name for p in marker.iterdir()}
+    assert "h4-n4-r0" in runs          # first group used all 4
+    assert any(r == "h1-n2-r1" for r in runs), runs  # clamp 3 → 2
+    assert not any(r.startswith("h3-n2") for r in runs)
