@@ -26,6 +26,7 @@ import sys
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..launcher.runner import DEFAULT_COORDINATOR_PORT
 from ..utils.logging import logger
 from .elasticity import ElasticityConfig, compute_elastic_config
 
@@ -34,7 +35,7 @@ from .elasticity import ElasticityConfig, compute_elastic_config
 class AgentConfig:
     max_restarts: int = 10
     poll_interval_s: float = 1.0
-    coordinator_port: int = 8476
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT
     #: grace period between SIGTERM and SIGKILL when tearing a group down
     term_timeout_s: float = 10.0
 
@@ -62,12 +63,17 @@ class ElasticAgent:
         self.restart_count = 0
         self.procs: List[subprocess.Popen] = []
         self.current_members: List[str] = []
+        # members whose worker crashed: excluded from later rendezvous so a
+        # persistently-failing host can't flap in and out of the group (a
+        # health-checking members_fn that stops listing them works the same)
+        self.banned: set = set()
 
     # -- world sizing ---------------------------------------------------
 
     def admitted_members(self, members: List[str]) -> List[str]:
         """Trim membership to the largest VALID world size (elastic batch
         math); with no elasticity config any size is valid."""
+        members = [m for m in members if m not in self.banned]
         if self.elastic_config is None or not members:
             return members
         from ..runtime.config_utils import ConfigError
@@ -156,13 +162,12 @@ class ElasticAgent:
                     logger.error("elastic agent: max_restarts exhausted")
                     return 1
                 self.restart_count += 1
-                # failed member drops out of the next rendezvous
-                if any_failed and not membership_changed:
-                    failed = [m for m, rc in zip(self.current_members, rcs)
-                              if rc not in (None, 0)]
-                    new_members = [m for m in self.current_members
-                                   if m not in failed]
-                    new_members = self.admitted_members(new_members)
+                if any_failed:
+                    # crashed members are banned from later rendezvous
+                    self.banned.update(
+                        m for m, rc in zip(self.current_members, rcs)
+                        if rc not in (None, 0))
+                    new_members = self.admitted_members(self.members_fn())
                 if not new_members:
                     logger.error("elastic agent: no admissible members left")
                     return 1

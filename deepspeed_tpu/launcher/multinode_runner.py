@@ -58,6 +58,24 @@ def _export_string(environment: Dict[str, str]) -> str:
     return " ".join(f"{k}={shlex.quote(v)}" for k, v in environment.items())
 
 
+def _remote_command(environment: Dict[str, str], program: List[str]) -> str:
+    """The shell line run on a remote host: cd to the launch cwd, export the
+    rendezvous env, exec the program (shared by the ssh and pdsh backends)."""
+    return f"cd {shlex.quote(os.getcwd())} && " \
+           f"{_export_string(environment)} " \
+           f"{' '.join(shlex.quote(c) for c in program)}"
+
+
+def natural_sorted(hosts: List[str]) -> List[str]:
+    """Sort host names the way Slurm orders nodelists (numeric suffixes
+    compare numerically: node2 < node10)."""
+    def key(h):
+        return [int(p) if p.isdigit() else p
+                for p in re.split(r"(\d+)", h)]
+
+    return sorted(hosts, key=key)
+
+
 class SSHRunner(MultiNodeRunner):
     """Plain ssh fan-out (one connection per host) — the zero-dependency
     default."""
@@ -72,11 +90,9 @@ class SSHRunner(MultiNodeRunner):
         raise NotImplementedError("ssh launches per host")
 
     def get_per_host_cmd(self, host, environment, program):
-        remote = f"cd {shlex.quote(os.getcwd())} && " \
-                 f"{_export_string(environment)} " \
-                 f"{' '.join(shlex.quote(c) for c in program)}"
         return ["ssh", "-o", "StrictHostKeyChecking=no",
-                *shlex.split(self.launcher_args), host, remote]
+                *shlex.split(self.launcher_args), host,
+                _remote_command(environment, program)]
 
 
 class PDSHRunner(MultiNodeRunner):
@@ -97,11 +113,8 @@ class PDSHRunner(MultiNodeRunner):
         env = dict(environment)
         env["PDSH_RCMD_TYPE"] = "ssh"
         env["DSTPU_HOSTS"] = ",".join(hosts)
-        exports = _export_string(env)
-        remote = f"cd {shlex.quote(os.getcwd())} && {exports} " \
-                 f"{' '.join(shlex.quote(c) for c in program)}"
         return ["pdsh", "-S", "-f", "1024", *shlex.split(self.launcher_args),
-                "-w", ",".join(hosts), remote]
+                "-w", ",".join(hosts), _remote_command(env, program)]
 
 
 class OpenMPIRunner(MultiNodeRunner):

@@ -150,6 +150,13 @@ def launch(args: argparse.Namespace) -> int:
     if not runner.backend_exists():
         raise RuntimeError(
             f"launcher backend {runner.name!r} not available on this host")
+    if args.launcher == "slurm":
+        # srun assigns SLURM_PROCID in nodelist (natural-sorted) order, not
+        # in -w order — align our host order so rank 0 == the coordinator
+        from .multinode_runner import natural_sorted
+
+        host_list = natural_sorted(host_list)
+        hosts = {h: hosts[h] for h in host_list}
     coordinator = host_list[0]
     world_blob = encode_world_info(hosts)
 

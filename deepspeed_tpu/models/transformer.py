@@ -57,6 +57,9 @@ class TransformerConfig:
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # 'capacity' (GShard buckets; the ep all-to-all path) | 'dropless'
+    # (grouped-GEMM, no token dropping — moe/dropless.py)
+    moe_routing: str = "capacity"
     # dtypes
     dtype: str = "bfloat16"  # compute dtype
     param_dtype: str = "float32"  # master weights

@@ -1,0 +1,73 @@
+"""Dropless MoE dispatch via grouped GEMM.
+
+Capability analogue of the reference's modern MoE inference/training path
+(``inference/v2/kernels/cutlass_ops/moe_gemm`` + dropless routing): no
+capacity buckets, no token dropping — every top-k assignment is computed.
+Tokens are scattered once into the tile-aligned grouped layout (see
+``ops/pallas/grouped_matmul``), the expert FFN runs as three grouped GEMMs,
+and a scatter-add combines weighted expert outputs back per token.
+
+Compared with the capacity-einsum path (``moe/layer.py``) this removes the
+(B,S,E,C)-onehot dispatch/combine contractions entirely and computes exactly
+T = B·S·k token-rows of FFN (plus ≤ E·tile rows of alignment padding) instead
+of E·C capacity rows.
+
+Select with ``TransformerConfig.moe_routing = 'dropless'`` (default
+'capacity' keeps the GShard-style path, which is also the expert-parallel
+all-to-all path — dropless currently targets replicated/dp expert weights).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.pallas.grouped_matmul import grouped_matmul, tile_aligned_layout
+
+
+def dropless_moe_block_with_losses(x: jax.Array, p: Dict[str, Any], cfg,
+                                   tile_m: int = 512,
+                                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """x: (B, S, H) → (y, aux_loss, z_loss); router losses as in
+    ``moe/layer.py`` (Switch aux loss + St-MoE z-loss)."""
+    B, S, H = x.shape
+    E, k = cfg.num_experts, cfg.moe_top_k
+    dt = x.dtype
+
+    logits = x.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # (B,S,E)
+    z = jax.nn.logsumexp(logits, axis=-1)
+    z_loss = jnp.mean(z ** 2)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (B,S,k)
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    me = jnp.mean(probs, axis=(0, 1))
+    ce = jnp.mean(jax.nn.one_hot(gate_idx[..., 0], E), axis=(0, 1))
+    aux_loss = E * jnp.sum(me * ce)
+
+    T = B * S * k
+    expert_flat = gate_idx.reshape(T)
+    token_flat = jnp.repeat(jnp.arange(B * S), k)
+    gates_flat = gate_vals.reshape(T)
+
+    positions, tile_group, pad_sizes, M_pad = tile_aligned_layout(
+        expert_flat, E, T, tile_m)
+
+    xs = jnp.zeros((M_pad, H), dt).at[positions].set(
+        x.reshape(B * S, H)[token_flat])
+
+    def gmm(a, w_key):
+        return grouped_matmul(a, p[w_key].astype(dt), tile_group, pad_sizes,
+                              tile_m=tile_m)
+
+    if "w_gate" in p:
+        hmid = jax.nn.silu(gmm(xs, "w_gate")) * gmm(xs, "w_in")
+    else:
+        hmid = jax.nn.gelu(gmm(xs, "w_in"), approximate=True)
+    ys = gmm(hmid, "w_out")  # (M_pad, H)
+
+    weighted = ys[positions] * gates_flat[:, None].astype(dt)  # (T, H)
+    y = jnp.zeros((B * S, H), dt).at[token_flat].add(weighted)
+    return y.reshape(B, S, H), aux_loss, z_loss

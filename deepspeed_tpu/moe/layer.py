@@ -102,6 +102,10 @@ def moe_block_with_losses(x: jax.Array, p: Dict[str, Any], cfg
                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Like dense_moe_block but returns (y, aux_loss, z_loss) explicitly —
     used by model forwards that accumulate the router losses."""
+    if getattr(cfg, "moe_routing", "capacity") == "dropless":
+        from .dropless import dropless_moe_block_with_losses
+
+        return dropless_moe_block_with_losses(x, p, cfg)
     dt = x.dtype
     E = cfg.num_experts
     logits = x.astype(jnp.float32) @ p["router"].astype(jnp.float32)

@@ -249,3 +249,40 @@ time.sleep(1.0)
     assert "h4-n4-r0" in runs          # first group used all 4
     assert any(r == "h1-n2-r1" for r in runs), runs  # clamp 3 → 2
     assert not any(r.startswith("h3-n2") for r in runs)
+
+
+def test_elastic_agent_bans_flapping_member(tmp_path):
+    """A persistently failing member with a STATIC members_fn must not flap
+    in and out: it is banned after its crash and the survivors finish."""
+    import sys
+    from deepspeed_tpu.elasticity.elastic_agent import AgentConfig, ElasticAgent
+
+    marker = tmp_path / "runs"
+    marker.mkdir()
+    script = tmp_path / "worker.py"
+    script.write_text(f"""
+import os, sys, time
+m = os.environ["DSTPU_ELASTIC_MEMBER"]
+open(r"{marker}" + "/" + m + "-r" + os.environ["DSTPU_RESTART_COUNT"], "w").close()
+if m == "bad":
+    sys.exit(1)
+time.sleep(0.3)
+""")
+    agent = ElasticAgent(
+        [sys.executable, str(script)],
+        members_fn=lambda: ["good1", "bad", "good2"],  # static: bad re-listed
+        agent_config=AgentConfig(max_restarts=4, poll_interval_s=0.1,
+                                 term_timeout_s=2.0))
+    rc = agent.run()
+    assert rc == 0
+    runs = {p.name for p in marker.iterdir()}
+    assert "bad-r0" in runs
+    assert not any(r.startswith("bad-r1") for r in runs)  # banned, no flap
+    assert agent.restart_count == 1
+
+
+def test_natural_sorted_slurm_order():
+    from deepspeed_tpu.launcher.multinode_runner import natural_sorted
+
+    assert natural_sorted(["node10", "node2", "node1"]) == \
+        ["node1", "node2", "node10"]
