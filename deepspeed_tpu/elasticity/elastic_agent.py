@@ -38,6 +38,11 @@ class AgentConfig:
     coordinator_port: int = DEFAULT_COORDINATOR_PORT
     #: grace period between SIGTERM and SIGKILL when tearing a group down
     term_timeout_s: float = 10.0
+    #: consecutive crashes before a member is banned from rendezvous for
+    #: good; below this a crashed member only sits out the immediate restart
+    #: (a coordinator death makes every worker exit nonzero at once — those
+    #: hosts are healthy and must be allowed back)
+    member_max_fails: int = 3
 
 
 class ElasticAgent:
@@ -63,10 +68,13 @@ class ElasticAgent:
         self.restart_count = 0
         self.procs: List[subprocess.Popen] = []
         self.current_members: List[str] = []
-        # members whose worker crashed: excluded from later rendezvous so a
-        # persistently-failing host can't flap in and out of the group (a
-        # health-checking members_fn that stops listing them works the same)
+        # members whose worker crashed member_max_fails times in a row:
+        # excluded from later rendezvous so a persistently-failing host
+        # can't flap in and out of the group. A single crash only sits out
+        # the immediate restart (self._strikes tracks the streak); cascading
+        # exits caused by a coordinator death therefore don't kill the job.
         self.banned: set = set()
+        self._strikes: Dict[str, int] = {}
 
     # -- world sizing ---------------------------------------------------
 
@@ -163,11 +171,22 @@ class ElasticAgent:
                     return 1
                 self.restart_count += 1
                 if any_failed:
-                    # crashed members are banned from later rendezvous
-                    self.banned.update(
-                        m for m, rc in zip(self.current_members, rcs)
-                        if rc not in (None, 0))
-                    new_members = self.admitted_members(self.members_fn())
+                    failed = {m for m, rc in zip(self.current_members, rcs)
+                              if rc not in (None, 0)}
+                    for m in self.current_members:
+                        if m in failed:
+                            self._strikes[m] = self._strikes.get(m, 0) + 1
+                            if self._strikes[m] >= self.cfg.member_max_fails:
+                                self.banned.add(m)
+                        else:
+                            self._strikes.pop(m, None)  # streak broken
+                    admitted = self.admitted_members(self.members_fn())
+                    # crashed-but-not-banned members sit out this restart
+                    # only — unless that empties the group (e.g. every
+                    # worker died when the coordinator fell over)
+                    new_members = [m for m in admitted if m not in failed]
+                    if not new_members:
+                        new_members = admitted
                 if not new_members:
                     logger.error("elastic agent: no admissible members left")
                     return 1

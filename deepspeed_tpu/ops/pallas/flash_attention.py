@@ -2,9 +2,10 @@
 
 TPU-native replacement for the reference's fused attention CUDA kernels
 (``csrc/transformer/softmax_kernels.cu``, the inference attention in
-``csrc/transformer/inference`` and the CUTLASS evoformer kernels): one kernel
-computes softmax(QKᵀ)V with online (streaming) softmax so the S×S score
-matrix never materializes in HBM — O(S) memory instead of O(S²).
+``csrc/transformer/inference`` and the CUTLASS evoformer kernels) and for the
+block-sparse attention package (``deepspeed/ops/sparse_attention/matmul.py``):
+one kernel computes softmax(QKᵀ)V with online (streaming) softmax so the S×S
+score matrix never materializes in HBM — O(S) memory instead of O(S²).
 
 Design (classic FlashAttention-2 schedule on the MXU):
 * grid = (batch, heads, q_blocks, kv_blocks); TPU executes the innermost
@@ -13,6 +14,12 @@ Design (classic FlashAttention-2 schedule on the MXU):
 * causal masking skips fully-masked kv blocks via predication;
 * GQA: kv block index maps ``h → h * kv_heads // heads`` so grouped heads
   read the same K/V without materializing repeats;
+* segment ids (packed sequences) are masked in-kernel: q ids ride along
+  lanes as (B, S, 128) tiles, kv ids along sublanes as (B, 8, S) — the
+  layout the TPU vector unit can compare without relayouts;
+* arbitrary block-sparse masks: a scalar-prefetched (nq, nk) table gates
+  each tile, so fully-masked tiles cost nothing (the reference's
+  `sparse_attention` layouts — fixed/bigbird/longformer — compile to this);
 * backward = two kernels (dkdv: grid over kv blocks; dq: grid over q blocks)
   using the saved logsumexp, in the standard recompute formulation;
 * CPU fallback: interpreter mode (tests), or the XLA einsum path for odd
@@ -31,6 +38,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+NUM_LANES = 128
+NUM_SUBLANES = 8
 
 
 def _interpret() -> bool:
@@ -59,16 +68,65 @@ def _tile_in_band(q_start, k_start, block_q: int, block_k: int,
     return ok
 
 
+def _seg_mask(q_seg_tile, k_seg_tile, block_k: int):
+    """(block_q, NUM_LANES) q ids + (1, block_k) kv ids → (bq, bk) keep-mask.
+
+    q ids are lane-broadcast copies, so tiling them along lanes yields the
+    (bq, bk) matrix without any transpose/relayout (block_k % 128 == 0 on
+    TPU; interpret mode takes the 1-lane broadcast path for small test
+    blocks)."""
+    if block_k % NUM_LANES == 0:
+        qs = jnp.tile(q_seg_tile, (1, block_k // NUM_LANES))  # (bq, bk)
+    else:  # interpret-mode (CPU test) path for unaligned tiny blocks
+        qs = q_seg_tile[:, :1]
+    return jnp.equal(qs, k_seg_tile)
+
+
+def _unpack(refs, has_mask: bool, has_seg: bool, n_io: int):
+    """Split the kernel's positional refs into (mask_tab, q_seg, k_seg, io)."""
+    idx = 0
+    mask_tab = q_seg = k_seg = None
+    if has_mask:
+        mask_tab = refs[0]
+        idx = 1
+    if has_seg:
+        q_seg, k_seg = refs[idx], refs[idx + 1]
+        idx += 2
+    io = refs[idx:]
+    assert len(io) == n_io, (len(io), n_io, has_mask, has_seg)
+    return mask_tab, q_seg, k_seg, io
+
+
+def _masked_scores(q_ref, k_ref, q_seg_ref, k_seg_ref, q_start, k_start,
+                   sm_scale, causal, window, block_k, has_seg):
+    """QKᵀ·scale with the combined element keep-mask (band ∧ segments)
+    applied. Returns (s, keep); ``keep`` is None when nothing masks at the
+    element level. Shared by the forward and both backward kernels so mask
+    semantics can never desynchronize between passes."""
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    keep = None
+    if causal or window > 0:
+        keep = _band_mask(s.shape, q_start, k_start, causal, window)
+    if has_seg:
+        sm = _seg_mask(q_seg_ref[0], k_seg_ref[0, :1], block_k)
+        keep = sm if keep is None else keep & sm
+    if keep is not None:
+        s = jnp.where(keep, s, DEFAULT_MASK_VALUE)
+    return s, keep
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref,  # inputs
-                o_ref, lse_ref,  # outputs
-                acc_ref, m_ref, l_ref,  # scratch
-                *, sm_scale: float, causal: bool, block_q: int, block_k: int,
-                window: int):
+def _fwd_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
+                block_k: int, window: int, has_mask: bool, has_seg: bool):
+    mask_tab, q_seg_ref, k_seg_ref, io = _unpack(refs, has_mask, has_seg, 8)
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = io
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -81,21 +139,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref,  # inputs
     q_start = iq * block_q
     k_start = ik * block_k
 
-    should_run = _tile_in_band(q_start, k_start, block_q, block_k, causal, window)
+    should_run = _tile_in_band(q_start, k_start, block_q, block_k, causal,
+                               window)
+    if has_mask:
+        should_run = should_run & (mask_tab[iq, ik] != 0)
 
     @pl.when(should_run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
-        k = k_ref[0, 0].astype(jnp.float32)  # (bk, d)
         v = v_ref[0, 0].astype(jnp.float32)  # (bk, d)
-
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * sm_scale  # (bq, bk)
-
-        if causal or window > 0:
-            s = jnp.where(_band_mask(s.shape, q_start, k_start, causal, window),
-                          s, DEFAULT_MASK_VALUE)
+        s, keep = _masked_scores(q_ref, k_ref, q_seg_ref, k_seg_ref, q_start,
+                                 k_start, sm_scale, causal, window, block_k,
+                                 has_seg)  # (bq, bk)
 
         m_prev = m_ref[:]  # (bq, 1)
         l_prev = l_ref[:]
@@ -103,6 +157,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref,  # inputs
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)  # (bq, bk)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)  # DEFAULT_MASK_VALUE exp underflows,
+            # but fully-masked rows would otherwise get exp(MASK - MASK) = 1
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
 
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -119,44 +176,73 @@ def _fwd_kernel(q_ref, k_ref, v_ref,  # inputs
         lse_ref[0, 0] = jnp.where(l == 0.0, -jnp.inf, lse)
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, window=0
-               ) -> Tuple[jax.Array, jax.Array]:
+def _pallas_call(kernel, grid, in_specs, out_specs, out_shape, scratch_shapes,
+                 mask_tab, inputs):
+    """Dispatch with or without the scalar-prefetched block-mask table."""
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+    if mask_tab is not None:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+            out_specs=out_specs, scratch_shapes=scratch_shapes)
+        return pl.pallas_call(kernel, grid_spec=grid_spec,
+                              out_shape=out_shape, compiler_params=params,
+                              interpret=_interpret())(mask_tab, *inputs)
+    return pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, scratch_shapes=scratch_shapes,
+        compiler_params=params,
+        interpret=_interpret())(*inputs)
+
+
+def _flash_fwd(q, k, v, q_seg, k_seg, mask_tab, sm_scale, causal, block_q,
+               block_k, window=0) -> Tuple[jax.Array, jax.Array]:
     B, H, S, D = q.shape
     KV = k.shape[1]
     Skv = k.shape[2]
     nq = pl.cdiv(S, block_q)
     nk = pl.cdiv(Skv, block_k)
     group = H // KV
+    has_seg = q_seg is not None
 
     grid = (B, H, nq, nk)
-    out, lse = pl.pallas_call(
+    in_specs = []
+    inputs = []
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, block_q, NUM_LANES),
+                         lambda b, h, iq, ik, *_: (b, iq, 0)),
+            pl.BlockSpec((1, NUM_SUBLANES, block_k),
+                         lambda b, h, iq, ik, *_: (b, 0, ik)),
+        ]
+        inputs += [q_seg, k_seg]
+    in_specs += [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, h, iq, ik, *_: (b, h // group, ik, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, h, iq, ik, *_: (b, h // group, ik, 0)),
+    ]
+    inputs += [q, k, v]
+    out, lse = _pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, window=window),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, iq, ik: (b, h // group, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, iq, ik: (b, h // group, ik, 0)),
+                          block_q=block_q, block_k=block_k, window=window,
+                          has_mask=mask_tab is not None, has_seg=has_seg),
+        grid, in_specs,
+        [
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
-        ],
-        out_shape=[
+        [
             jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
             jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
         ],
-        scratch_shapes=[
+        [
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
-        interpret=_interpret(),
-    )(q, k, v)
+        mask_tab, inputs)
     return out, lse
 
 
@@ -165,14 +251,14 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, window=0
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref,
-                     dk_acc, dv_acc,
-                     *, sm_scale, causal, block_q, block_k, nq: int,
-                     window: int = 0):
+def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, nq: int,
+                     window: int, has_mask: bool, has_seg: bool):
     # grid: (B, KV, nk, group*nq) — the innermost dim walks every q block of
     # every query head in this kv head's group, accumulating straight into
     # the per-KV-head dk/dv (no (B, H, S, D) f32 intermediate).
+    mask_tab, q_seg_ref, k_seg_ref, io = _unpack(refs, has_mask, has_seg, 10)
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+     dk_ref, dv_ref, dk_acc, dv_acc) = io
     ik, iqg = pl.program_id(2), pl.program_id(3)
     niqg = pl.num_programs(3)
     iq = iqg % nq
@@ -184,23 +270,25 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q_start = iq * block_q
     k_start = ik * block_k
-    should_run = _tile_in_band(q_start, k_start, block_q, block_k, causal, window)
+    should_run = _tile_in_band(q_start, k_start, block_q, block_k, causal,
+                               window)
+    if has_mask:
+        should_run = should_run & (mask_tab[iq, ik] != 0)
 
     @pl.when(should_run)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
-        k = k_ref[0, 0].astype(jnp.float32)  # (bk, d)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)  # (bq, d)
         lse = lse_ref[0, 0]  # (bq, 1)
         delta = delta_ref[0, 0]  # (bq, 1)
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        if causal or window > 0:
-            s = jnp.where(_band_mask(s.shape, q_start, k_start, causal, window),
-                          s, DEFAULT_MASK_VALUE)
+        s, keep = _masked_scores(q_ref, k_ref, q_seg_ref, k_seg_ref, q_start,
+                                 k_start, sm_scale, causal, window, block_k,
+                                 has_seg)
         p = jnp.exp(s - lse)  # (bq, bk)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
 
         dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -216,10 +304,10 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc,
-                   *, sm_scale, causal, block_q, block_k,
-                   window: int = 0):
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, window: int,
+                   has_mask: bool, has_seg: bool):
+    mask_tab, q_seg_ref, k_seg_ref, io = _unpack(refs, has_mask, has_seg, 8)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = io
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -229,23 +317,25 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q_start = iq * block_q
     k_start = ik * block_k
-    should_run = _tile_in_band(q_start, k_start, block_q, block_k, causal, window)
+    should_run = _tile_in_band(q_start, k_start, block_q, block_k, causal,
+                               window)
+    if has_mask:
+        should_run = should_run & (mask_tab[iq, ik] != 0)
 
     @pl.when(should_run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0]  # (bq, 1)
         delta = delta_ref[0, 0]  # (bq, 1)
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        if causal or window > 0:
-            s = jnp.where(_band_mask(s.shape, q_start, k_start, causal, window),
-                          s, DEFAULT_MASK_VALUE)
+        s, keep = _masked_scores(q_ref, k_ref, q_seg_ref, k_seg_ref, q_start,
+                                 k_start, sm_scale, causal, window, block_k,
+                                 has_seg)
         p = jnp.exp(s - lse)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
@@ -258,13 +348,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, window, res, g):
-    q, k, v, out, lse = res
+    q, k, v, q_seg, k_seg, mask_tab, out, lse = res
     B, H, S, D = q.shape
     KV = k.shape[1]
     Skv = k.shape[2]
     group = H // KV
     nq = pl.cdiv(S, block_q)
     nk = pl.cdiv(Skv, block_k)
+    has_seg = q_seg is not None
 
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # (B, H, S, 1)
@@ -272,70 +363,90 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, window, res, g):
     # dk, dv: one pass per kv block; the innermost grid dim walks all
     # (group, q-block) pairs so GQA groups accumulate directly into the
     # (B, KV, Skv, D) result — no (B, H, Skv, D) f32 intermediate.
-    dk, dv = pl.pallas_call(
+    in_specs = []
+    inputs = []
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, block_q, NUM_LANES),
+                         lambda b, kv, ik, iqg, *_: (b, iqg % nq, 0)),
+            pl.BlockSpec((1, NUM_SUBLANES, block_k),
+                         lambda b, kv, ik, iqg, *_: (b, 0, ik)),
+        ]
+        inputs += [q_seg, k_seg]
+    in_specs += [
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, kv, ik, iqg, *_: (b, kv * group + iqg // nq,
+                                                 iqg % nq, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, kv, ik, iqg, *_: (b, kv, ik, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, kv, ik, iqg, *_: (b, kv, ik, 0)),
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, kv, ik, iqg, *_: (b, kv * group + iqg // nq,
+                                                 iqg % nq, 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda b, kv, ik, iqg, *_: (b, kv * group + iqg // nq,
+                                                 iqg % nq, 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda b, kv, ik, iqg, *_: (b, kv * group + iqg // nq,
+                                                 iqg % nq, 0)),
+    ]
+    inputs += [q, k, v, g, lse, delta]
+    dk, dv = _pallas_call(
         functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, nq=nq,
-                          window=window),
-        grid=(B, KV, nk, group * nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D),
-                         lambda b, kv, ik, iqg: (b, kv * group + iqg // nq,
-                                                 iqg % nq, 0)),
+                          window=window, has_mask=mask_tab is not None,
+                          has_seg=has_seg),
+        (B, KV, nk, group * nq), in_specs,
+        [
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, kv, ik, iqg: (b, kv, ik, 0)),
+                         lambda b, kv, ik, iqg, *_: (b, kv, ik, 0)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, kv, ik, iqg: (b, kv, ik, 0)),
-            pl.BlockSpec((1, 1, block_q, D),
-                         lambda b, kv, ik, iqg: (b, kv * group + iqg // nq,
-                                                 iqg % nq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b, kv, ik, iqg: (b, kv * group + iqg // nq,
-                                                 iqg % nq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b, kv, ik, iqg: (b, kv * group + iqg // nq,
-                                                 iqg % nq, 0)),
+                         lambda b, kv, ik, iqg, *_: (b, kv, ik, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_k, D), lambda b, kv, ik, iqg: (b, kv, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, kv, ik, iqg: (b, kv, ik, 0)),
-        ],
-        out_shape=[
+        [
             jax.ShapeDtypeStruct((B, KV, Skv, D), k.dtype),
             jax.ShapeDtypeStruct((B, KV, Skv, D), v.dtype),
         ],
-        scratch_shapes=[
+        [
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
-        interpret=_interpret(),
-    )(q, k, v, g, lse, delta)
+        mask_tab, inputs)
 
-    dq = pl.pallas_call(
+    in_specs = []
+    inputs = []
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, block_q, NUM_LANES),
+                         lambda b, h, iq, ik, *_: (b, iq, 0)),
+            pl.BlockSpec((1, NUM_SUBLANES, block_k),
+                         lambda b, h, iq, ik, *_: (b, 0, ik)),
+        ]
+        inputs += [q_seg, k_seg]
+    in_specs += [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, h, iq, ik, *_: (b, h // group, ik, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, h, iq, ik, *_: (b, h // group, ik, 0)),
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
+    ]
+    inputs += [q, k, v, g, lse, delta]
+    dq = _pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, window=window),
-        grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, iq, ik: (b, h // group, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, iq, ik: (b, h // group, ik, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, iq, ik: (b, h, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
-        interpret=_interpret(),
-    )(q, k, v, g, lse, delta)
+                          block_q=block_q, block_k=block_k, window=window,
+                          has_mask=mask_tab is not None, has_seg=has_seg),
+        (B, H, nq, nk), in_specs,
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, h, iq, ik, *_: (b, h, iq, 0)),
+        jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        [pltpu.VMEM((block_q, D), jnp.float32)],
+        mask_tab, inputs)
 
-    return dq, dk, dv
+    return dq, dk, dv, None, None, None
 
 
 # ---------------------------------------------------------------------------
@@ -343,15 +454,19 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, window, res, g):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_bhsd(q, k, v, sm_scale, causal, block_q, block_k, window):
-    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash_attention_bhsd(q, k, v, q_seg, k_seg, mask_tab,
+                          sm_scale, causal, block_q, block_k, window):
+    out, _ = _flash_fwd(q, k, v, q_seg, k_seg, mask_tab, sm_scale, causal,
+                        block_q, block_k, window)
     return out
 
 
-def _fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, window):
-    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, window)
-    return out, (q, k, v, out, lse)
+def _fwd_rule(q, k, v, q_seg, k_seg, mask_tab, sm_scale, causal, block_q,
+              block_k, window):
+    out, lse = _flash_fwd(q, k, v, q_seg, k_seg, mask_tab, sm_scale, causal,
+                          block_q, block_k, window)
+    return out, (q, k, v, q_seg, k_seg, mask_tab, out, lse)
 
 
 _flash_attention_bhsd.defvjp(
@@ -363,15 +478,18 @@ _flash_attention_bhsd.defvjp(
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, sm_scale: Optional[float] = None,
                     block_q: int = 1024, block_k: int = 1024,
-                    segment_ids=None, window: int = 0) -> jax.Array:
+                    segment_ids=None, window: int = 0,
+                    block_mask=None) -> jax.Array:
     """Fused attention. q: (B, S, H, D); k/v: (B, S, KV, D) with KV | H.
 
-    Differentiable (custom VJP); supports causal masking, GQA and sliding-
-    window (``window`` > 0 keeps keys in (query-window, query] — the
-    Mistral-style band and the practical block-sparse-attention pattern:
-    out-of-band tiles are skipped entirely). Falls back to the XLA einsum
-    path when shapes don't fit the kernel constraints (segment_ids,
-    tiny/unaligned sequence lengths).
+    Differentiable (custom VJP); supports causal masking, GQA, sliding-
+    window (``window`` > 0 keeps keys in (query-window, query]), packed-
+    sequence ``segment_ids`` ((B, S) int32, masked in-kernel), and arbitrary
+    block-sparse ``block_mask`` ((S/block_q, S/block_k) bool/int — tiles
+    where the mask is 0 are skipped entirely; the reference's
+    ``deepspeed.ops.sparse_attention`` layouts lower to this). All masks
+    compose. Falls back to the XLA einsum path when shapes don't fit the
+    kernel constraints (tiny/unaligned sequence lengths).
     """
     B, S, H, D = q.shape
     KV = k.shape[2]
@@ -393,27 +511,42 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 return d
         return cap  # no aligned divisor; the usable-gate will fall back
 
-    block_q = pick_block(S, block_q)
-    block_k = pick_block(k.shape[1], block_k)
-    usable = (segment_ids is None and S % block_q == 0
-              and k.shape[1] % block_k == 0 and H % KV == 0)
-    if segment_ids is not None and window > 0:
-        raise NotImplementedError(
-            "segment_ids + sliding window is not supported yet")
+    if block_mask is None:
+        # block sizes are free parameters without a mask table; with one,
+        # the table's granularity pins them
+        block_q = pick_block(S, block_q)
+        block_k = pick_block(k.shape[1], block_k)
+    usable = (S % block_q == 0 and k.shape[1] % block_k == 0 and H % KV == 0)
+    if segment_ids is not None:
+        # the in-kernel lane-tiling needs 128-aligned kv blocks on TPU
+        usable = usable and (block_k % NUM_LANES == 0 or _interpret())
+    if block_mask is not None:
+        nq, nk = pl.cdiv(S, block_q), pl.cdiv(k.shape[1], block_k)
+        if block_mask.shape != (nq, nk):
+            raise ValueError(
+                f"block_mask shape {block_mask.shape} != grid ({nq}, {nk}) "
+                f"for S={S}, block_q={block_q}, block_k={block_k}")
     if not usable:
-        from ...models.transformer import xla_attention
+        return _reference_attention(q, k, v, causal=causal, window=window,
+                                    segment_ids=segment_ids,
+                                    block_mask=block_mask, block_q=block_q,
+                                    block_k=block_k, sm_scale=sm_scale)
 
-        if window > 0:
-            return _windowed_reference(q, k, v, causal, window,
-                                       sm_scale=sm_scale)
-        return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    q_seg3 = k_seg3 = None
+    if segment_ids is not None:
+        seg = segment_ids.astype(jnp.int32)
+        q_seg3 = jax.lax.broadcast_in_dim(seg, (B, S, NUM_LANES), (0, 1))
+        k_seg3 = jax.lax.broadcast_in_dim(seg, (B, NUM_SUBLANES, S), (0, 2))
+    mask_tab = None
+    if block_mask is not None:
+        mask_tab = jnp.asarray(block_mask, jnp.int32)
 
     # kernel layout is (B, H, S, D)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_attention_bhsd(qt, kt, vt, sm_scale, causal, block_q, block_k,
-                                window)
+    out = _flash_attention_bhsd(qt, kt, vt, q_seg3, k_seg3, mask_tab,
+                                sm_scale, causal, block_q, block_k, window)
     return out.transpose(0, 2, 1, 3)
 
 
@@ -424,25 +557,48 @@ def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None
     return xla_attention(q, k, v, causal=causal)
 
 
-def _windowed_reference(q, k, v, causal: bool, window: int,
-                        sm_scale: Optional[float] = None):
-    """XLA reference with the sliding-window band mask: keys in
-    (query-window, query] (the band implies the causal upper bound)."""
-    import math as _math
-
+def _reference_attention(q, k, v, causal: bool, window: int, segment_ids,
+                         block_mask, block_q: int, block_k: int,
+                         sm_scale: Optional[float] = None):
+    """XLA einsum path implementing the full mask algebra (band ∧ segments ∧
+    block mask) — the fallback for kernel-unfriendly shapes and the numeric
+    oracle for the kernel tests."""
     B, S, H, D = q.shape
+    Skv = k.shape[1]
     KV = k.shape[2]
     if KV != H:
         rep = H // KV
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    scale = sm_scale if sm_scale is not None else 1.0 / _math.sqrt(D)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     rows = jnp.arange(S)[:, None]
-    cols = jnp.arange(S)[None, :]
-    keep = (cols > rows - window) & (cols <= rows)
-    logits = jnp.where(keep[None, None], logits, -1e30)
+    cols = jnp.arange(Skv)[None, :]
+    keep = jnp.ones((S, Skv), bool)
+    if window > 0:
+        keep = (cols > rows - window) & (cols <= rows)
+    elif causal:
+        keep = rows >= cols
+    if block_mask is not None:
+        bm = jnp.asarray(block_mask) != 0
+        elem = jnp.repeat(jnp.repeat(bm, block_q, axis=0), block_k, axis=1)
+        keep = keep & elem[:S, :Skv]
+    keep = jnp.broadcast_to(keep[None], (B, S, Skv))
+    if segment_ids is not None:
+        keep = keep & (segment_ids[:, :, None] == segment_ids[:, None, :])
+    logits = jnp.where(keep[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
+    # fully-masked rows: softmax over all -1e30 gives uniform; zero them
+    any_keep = jnp.any(keep, axis=-1)[:, None, :, None]
+    probs = jnp.where(any_keep, probs, 0.0)
     return jnp.einsum("bhst,bthd->bshd", probs,
                       v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _windowed_reference(q, k, v, causal: bool, window: int,
+                        sm_scale: Optional[float] = None):
+    """Back-compat alias for the banded reference path."""
+    return _reference_attention(q, k, v, causal=causal, window=window,
+                                segment_ids=None, block_mask=None,
+                                block_q=1, block_k=1, sm_scale=sm_scale)

@@ -271,14 +271,46 @@ time.sleep(0.3)
     agent = ElasticAgent(
         [sys.executable, str(script)],
         members_fn=lambda: ["good1", "bad", "good2"],  # static: bad re-listed
+        agent_config=AgentConfig(max_restarts=12, poll_interval_s=0.1,
+                                 term_timeout_s=2.0, member_max_fails=2))
+    rc = agent.run()
+    assert rc == 0
+    assert "bad" in agent.banned  # struck out after member_max_fails crashes
+    runs = {p.name for p in marker.iterdir()}
+    assert "bad-r0" in runs
+    # each crash costs one sit-out restart + one rejoin restart; after the
+    # second crash the member is banned and never launched again
+    bad_runs = {r for r in runs if r.startswith("bad-")}
+    assert len(bad_runs) == 2, bad_runs
+    assert agent.restart_count <= 5
+
+
+def test_elastic_agent_survives_cascading_crash(tmp_path):
+    """Every worker exiting nonzero at once (coordinator death) must NOT ban
+    the healthy hosts — the group restarts with full membership."""
+    import sys
+    from deepspeed_tpu.elasticity.elastic_agent import AgentConfig, ElasticAgent
+
+    state = tmp_path / "attempt"
+    script = tmp_path / "worker.py"
+    # first group: every worker exits 1; later groups: clean exit
+    script.write_text(f"""
+import os, sys, time
+p = r"{state}" + "-" + os.environ["DSTPU_ELASTIC_MEMBER"]
+if not os.path.exists(p):
+    open(p, "w").close()
+    sys.exit(1)
+time.sleep(0.2)
+""")
+    agent = ElasticAgent(
+        [sys.executable, str(script)],
+        members_fn=lambda: ["h1", "h2", "h3"],
         agent_config=AgentConfig(max_restarts=4, poll_interval_s=0.1,
                                  term_timeout_s=2.0))
     rc = agent.run()
     assert rc == 0
-    runs = {p.name for p in marker.iterdir()}
-    assert "bad-r0" in runs
-    assert not any(r.startswith("bad-r1") for r in runs)  # banned, no flap
-    assert agent.restart_count == 1
+    assert agent.banned == set()  # one synchronized crash bans nobody
+    assert agent.restart_count == 1  # single restart with full membership
 
 
 def test_natural_sorted_slurm_order():
