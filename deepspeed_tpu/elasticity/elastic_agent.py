@@ -39,10 +39,14 @@ class AgentConfig:
     #: grace period between SIGTERM and SIGKILL when tearing a group down
     term_timeout_s: float = 10.0
     #: consecutive crashes before a member is banned from rendezvous for
-    #: good; below this a crashed member only sits out the immediate restart
+    #: good; below this a crashed member only sits out a cool-down
     #: (a coordinator death makes every worker exit nonzero at once — those
     #: hosts are healthy and must be allowed back)
     member_max_fails: int = 3
+    #: how long a crashed member stays out of rendezvous before it may
+    #: rejoin; keeps a single crash from burning two restarts (one to drop
+    #: the member, one membership-change to re-admit it a poll later)
+    rejoin_cooldown_s: float = 30.0
 
 
 class ElasticAgent:
@@ -75,13 +79,20 @@ class ElasticAgent:
         # exits caused by a coordinator death therefore don't kill the job.
         self.banned: set = set()
         self._strikes: Dict[str, int] = {}
+        #: member → monotonic time at which it may rejoin rendezvous
+        self._cooldown: Dict[str, float] = {}
 
     # -- world sizing ---------------------------------------------------
 
-    def admitted_members(self, members: List[str]) -> List[str]:
+    def admitted_members(self, members: List[str],
+                         ignore_cooldown: bool = False) -> List[str]:
         """Trim membership to the largest VALID world size (elastic batch
         math); with no elasticity config any size is valid."""
         members = [m for m in members if m not in self.banned]
+        if not ignore_cooldown:
+            now = time.monotonic()
+            members = [m for m in members
+                       if self._cooldown.get(m, 0.0) <= now]
         if self.elastic_config is None or not members:
             return members
         from ..runtime.config_utils import ConfigError
@@ -173,20 +184,26 @@ class ElasticAgent:
                 if any_failed:
                     failed = {m for m, rc in zip(self.current_members, rcs)
                               if rc not in (None, 0)}
+                    until = time.monotonic() + self.cfg.rejoin_cooldown_s
                     for m in self.current_members:
                         if m in failed:
                             self._strikes[m] = self._strikes.get(m, 0) + 1
                             if self._strikes[m] >= self.cfg.member_max_fails:
                                 self.banned.add(m)
+                            else:
+                                self._cooldown[m] = until
                         else:
                             self._strikes.pop(m, None)  # streak broken
-                    admitted = self.admitted_members(self.members_fn())
-                    # crashed-but-not-banned members sit out this restart
-                    # only — unless that empties the group (e.g. every
-                    # worker died when the coordinator fell over)
-                    new_members = [m for m in admitted if m not in failed]
+                    # crashed-but-not-banned members sit out the cool-down
+                    # (admitted_members filters them) — unless that empties
+                    # the group (e.g. every worker died together when the
+                    # coordinator fell over): then clear cool-downs and
+                    # restart with full membership
+                    new_members = self.admitted_members(self.members_fn())
                     if not new_members:
-                        new_members = admitted
+                        self._cooldown.clear()
+                        new_members = self.admitted_members(
+                            self.members_fn(), ignore_cooldown=True)
                 if not new_members:
                     logger.error("elastic agent: no admissible members left")
                     return 1

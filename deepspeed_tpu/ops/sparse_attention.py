@@ -70,6 +70,32 @@ class SparsityConfig:
         layout[np.arange(n), np.arange(n)] = True
         return layout
 
+    @staticmethod
+    def _apply_global_blocks(layout: np.ndarray, starts: Sequence[int],
+                             ends: Optional[Sequence[int]]) -> None:
+        """Mark global rows+columns: ``starts[i]`` .. ``ends[i]`` (exclusive;
+        ``ends=None`` → single blocks) attend everywhere and are attended by
+        everyone."""
+        starts = list(starts)
+        ends = list(ends) if ends is not None else [s + 1 for s in starts]
+        for s, e in zip(starts, ends):
+            layout[s:e, :] = True
+            layout[:, s:e] = True
+
+    def _add_random_blocks(self, layout: np.ndarray,
+                           rng: np.random.RandomState, num: int) -> None:
+        """Per row, keep ``num`` random blocks (row-causal when
+        unidirectional). Seeded: deterministic across SPMD processes."""
+        if not num:
+            return
+        n = layout.shape[0]
+        for i in range(n):
+            hi = i + 1 if self.causal else n
+            cand = np.arange(hi)
+            if len(cand):
+                layout[i, rng.choice(cand, size=min(num, len(cand)),
+                                     replace=False)] = True
+
 
 @dataclasses.dataclass
 class DenseSparsityConfig(SparsityConfig):
@@ -123,16 +149,10 @@ class BigBirdSparsityConfig(SparsityConfig):
                    self.num_random_blocks)
         layout = np.zeros((n, n), bool)
         half = W // 2
-        rng = np.random.RandomState(self.seed)
         for i in range(n):
             layout[i, max(i - half, 0):min(i + half + 1, n)] = True  # window
-            hi = i + 1 if self.causal else n
-            cand = np.arange(hi)
-            if len(cand):
-                layout[i, rng.choice(cand, size=min(R, len(cand)),
-                                     replace=False)] = True
-        layout[:G, :] = True  # global rows/cols attend everywhere
-        layout[:, :G] = True
+        self._add_random_blocks(layout, np.random.RandomState(self.seed), R)
+        self._apply_global_blocks(layout, range(G), None)
         return self._finalize(layout)
 
 
@@ -151,13 +171,8 @@ class BSLongformerSparsityConfig(SparsityConfig):
         half = self.num_sliding_window_blocks // 2
         for i in range(n):
             layout[i, max(i - half, 0):min(i + half + 1, n)] = True
-        starts = list(self.global_block_indices)
-        ends = (list(self.global_block_end_indices)
-                if self.global_block_end_indices is not None
-                else [s + 1 for s in starts])
-        for s, e in zip(starts, ends):
-            layout[s:e, :] = True
-            layout[:, s:e] = True
+        self._apply_global_blocks(layout, self.global_block_indices,
+                                  self.global_block_end_indices)
         return self._finalize(layout)
 
 
@@ -185,22 +200,10 @@ class VariableSparsityConfig(SparsityConfig):
             layout[i:i + w, i:i + w] = True
             i += w
             widx += 1
-        rng = np.random.RandomState(self.seed)
-        if self.num_random_blocks:
-            for r in range(n):
-                hi = r + 1 if self.causal else n
-                cand = np.arange(hi)
-                if len(cand):
-                    layout[r, rng.choice(
-                        cand, size=min(self.num_random_blocks, len(cand)),
-                        replace=False)] = True
-        starts = list(self.global_block_indices)
-        ends = (list(self.global_block_end_indices)
-                if self.global_block_end_indices is not None
-                else [s + 1 for s in starts])
-        for s, e in zip(starts, ends):
-            layout[s:e, :] = True
-            layout[:, s:e] = True
+        self._add_random_blocks(layout, np.random.RandomState(self.seed),
+                                self.num_random_blocks)
+        self._apply_global_blocks(layout, self.global_block_indices,
+                                  self.global_block_end_indices)
         return self._finalize(layout)
 
 

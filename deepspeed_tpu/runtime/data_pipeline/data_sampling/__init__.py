@@ -1,0 +1,12 @@
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder,
+                              best_fitting_dtype, make_builder)
+from .data_analyzer import DataAnalyzer
+from .variable_batch_size_and_lr import (VariableBatchConfig,
+                                         batch_by_token_budget,
+                                         lr_scale_for_batch)
+
+__all__ = [
+    "MMapIndexedDataset", "MMapIndexedDatasetBuilder", "best_fitting_dtype",
+    "make_builder", "DataAnalyzer", "VariableBatchConfig",
+    "batch_by_token_budget", "lr_scale_for_batch",
+]

@@ -1,0 +1,122 @@
+"""Offline dataset analysis: per-sample metrics → curriculum index files.
+
+Capability analogue of the reference's
+``data_sampling/data_analyzer.py`` (``DataAnalyzer.run_map`` /
+``run_reduce``): compute one or more metrics over every sample of a dataset
+(sequence length, vocab rarity, …), in parallel, and persist both
+directions of the lookup:
+
+* ``<metric>_sample_to_metric.npy`` — (N,) value per sample id;
+* ``<metric>_metric_to_sample.npz`` — CSR grouping: sorted unique metric
+  values + row pointers + sample ids, so a curriculum scheduler can fetch
+  "all samples with difficulty ≤ d" as one contiguous slice.
+
+TPU-first notes: analysis is host-side numpy (no device involvement); the
+map phase shards the sample range over a thread pool (mmap datasets release
+the GIL in numpy slicing); worker outputs are written per-shard then merged
+so a crashed run resumes by re-running only missing shards — the same
+map/reduce split the reference implements with torch multiprocessing.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+MetricFn = Callable[[np.ndarray], float]
+
+
+class DataAnalyzer:
+    """``metric_fns`` maps metric name → fn(sample_tokens) → scalar.
+
+    ``metric_types`` per metric: ``single_value_per_sample`` (default;
+    produces both index files) or ``accumulate_value_over_samples``
+    (a dataset-wide reduction, e.g. total token count / vocab histogram —
+    produces ``<metric>_accumulated.npy``).
+    """
+
+    def __init__(self, dataset, metric_fns: Dict[str, MetricFn],
+                 save_path: str, num_workers: int = 4,
+                 metric_types: Optional[Dict[str, str]] = None,
+                 batch_size: int = 4096):
+        self.dataset = dataset
+        self.metric_fns = dict(metric_fns)
+        self.save_path = save_path
+        self.num_workers = max(1, num_workers)
+        self.metric_types = dict(metric_types or {})
+        self.batch_size = batch_size
+        os.makedirs(save_path, exist_ok=True)
+
+    # -- map ------------------------------------------------------------
+
+    def _shard_path(self, metric: str, shard: int) -> str:
+        return os.path.join(self.save_path, f"{metric}_shard{shard}.npy")
+
+    def run_map(self) -> None:
+        """Compute metric values for every sample, sharded over workers.
+        Idempotent: existing shard files are kept (crash resume)."""
+        n = len(self.dataset)
+        bounds = np.linspace(0, n, self.num_workers + 1, dtype=np.int64)
+
+        def work(shard: int) -> None:
+            lo, hi = int(bounds[shard]), int(bounds[shard + 1])
+            todo = {m: fn for m, fn in self.metric_fns.items()
+                    if not os.path.exists(self._shard_path(m, shard))}
+            if not todo:
+                return
+            vals = {m: np.empty(hi - lo, np.float64) for m in todo}
+            for i in range(lo, hi):
+                sample = np.asarray(self.dataset[i])
+                for m, fn in todo.items():
+                    vals[m][i - lo] = fn(sample)
+            for m in todo:
+                np.save(self._shard_path(m, shard), vals[m])
+
+        with ThreadPoolExecutor(self.num_workers) as ex:
+            list(ex.map(work, range(self.num_workers)))
+
+    # -- reduce ---------------------------------------------------------
+
+    def run_reduce(self) -> Dict[str, str]:
+        """Merge shards into the final index files; returns metric → path
+        of the sample_to_metric (or accumulated) artifact."""
+        out: Dict[str, str] = {}
+        for m in self.metric_fns:
+            shards = [np.load(self._shard_path(m, s))
+                      for s in range(self.num_workers)]
+            merged = np.concatenate(shards) if shards else np.empty(0)
+            kind = self.metric_types.get(m, "single_value_per_sample")
+            if kind == "accumulate_value_over_samples":
+                path = os.path.join(self.save_path, f"{m}_accumulated.npy")
+                np.save(path, merged.sum())
+                out[m] = path
+                continue
+            s2m = os.path.join(self.save_path, f"{m}_sample_to_metric.npy")
+            np.save(s2m, merged)
+            # CSR: metric value → sample ids
+            order = np.argsort(merged, kind="stable")
+            svals = merged[order]
+            uniq, starts = np.unique(svals, return_index=True)
+            row_ptr = np.concatenate([starts, [len(svals)]])
+            np.savez(os.path.join(self.save_path,
+                                  f"{m}_metric_to_sample.npz"),
+                     values=uniq, row_ptr=row_ptr, sample_ids=order)
+            out[m] = s2m
+        return out
+
+    def run(self) -> Dict[str, str]:
+        self.run_map()
+        return self.run_reduce()
+
+
+def samples_up_to_difficulty(save_path: str, metric: str,
+                             max_value: float) -> np.ndarray:
+    """Curriculum query: sample ids whose metric ≤ max_value, one slice off
+    the CSR index (reference: the sampler's difficulty-range lookup)."""
+    z = np.load(os.path.join(save_path, f"{metric}_metric_to_sample.npz"))
+    hi = int(np.searchsorted(z["values"], max_value, side="right"))
+    end = int(z["row_ptr"][hi])
+    return z["sample_ids"][:end]

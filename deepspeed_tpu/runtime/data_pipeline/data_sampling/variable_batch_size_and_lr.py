@@ -1,0 +1,148 @@
+"""Variable batch size with LR scaling, TPU-shaped.
+
+Capability analogue of the reference's
+``data_sampling/variable_batch_size_and_lr.py`` (batch samples by token
+budget instead of sample count; rescale the LR per batch so optimization
+stays comparable across batch sizes).
+
+TPU-first redesign: arbitrary per-batch shapes would force an XLA recompile
+per batch. Instead sample lengths are rounded up to a small ladder of
+*bucket* lengths (default: powers of two); every batch is (bs_L, L) with
+``bs_L = max_tokens // L``, so the number of distinct compiled shapes is
+bounded by the number of buckets — the compile cache stays warm while the
+token budget (and so step time and memory) stays constant across buckets.
+Each batch carries an ``lr_scale`` the engine multiplies into the schedule
+(linear or sqrt in the batch-size ratio, the same two rules the reference
+implements).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class VariableBatchConfig:
+    #: token budget per batch: batch size for bucket length L is budget // L
+    max_tokens_per_batch: int = 131072
+    #: padded lengths; None → powers of two covering the data
+    bucket_seqlens: Optional[Sequence[int]] = None
+    min_bucket_seqlen: int = 128
+    #: 'linear' | 'sqrt' | 'none' — LR scale vs the reference batch size
+    lr_scaling_method: str = "linear"
+    #: batch size the base LR was tuned for; None → the largest bucket's
+    base_batch_size: Optional[int] = None
+    #: drop batches smaller than this (stragglers at bucket tails)
+    min_batch_size: int = 1
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class VariableBatch:
+    sample_ids: np.ndarray  # (bs,)
+    seqlen: int  # padded length (bucket)
+    lr_scale: float
+
+
+def _buckets_for(seqlens: np.ndarray, cfg: VariableBatchConfig) -> List[int]:
+    if cfg.bucket_seqlens is not None:
+        return sorted(cfg.bucket_seqlens)
+    top = int(seqlens.max()) if len(seqlens) else cfg.min_bucket_seqlen
+    buckets = []
+    b = cfg.min_bucket_seqlen
+    while b < top:
+        buckets.append(b)
+        b *= 2
+    buckets.append(b)
+    return buckets
+
+
+def lr_scale_for_batch(batch_size: int, base_batch_size: int,
+                       method: str = "linear") -> float:
+    """Reference rules: linear (Goyal et al.) or sqrt (Hoffer et al.)."""
+    if method == "none":
+        return 1.0
+    r = batch_size / max(base_batch_size, 1)
+    if method == "linear":
+        return r
+    if method == "sqrt":
+        return float(np.sqrt(r))
+    raise ValueError(f"unknown lr_scaling_method {method!r}")
+
+
+def batch_by_token_budget(seqlens: Sequence[int], cfg: VariableBatchConfig,
+                          epoch: int = 0, shuffle: bool = True
+                          ) -> List[VariableBatch]:
+    """Partition sample ids into fixed-token-budget batches.
+
+    Every sample appears in exactly one batch (minus ``min_batch_size``
+    stragglers); batches are shuffled across buckets so the model doesn't
+    see lengths in sorted order (the reference's ``order_by_seqlen=False``
+    default).
+    """
+    seqlens = np.asarray(seqlens, np.int64)
+    buckets = _buckets_for(seqlens, cfg)
+    rng = np.random.default_rng(cfg.seed + epoch)
+
+    # assign each sample to the smallest bucket that holds it
+    bucket_of = np.searchsorted(buckets, seqlens, side="left")
+    bucket_of = np.clip(bucket_of, 0, len(buckets) - 1)
+    too_long = seqlens > buckets[-1]
+    if too_long.any():
+        # longer than the ladder: truncate to the top bucket (loader slices)
+        bucket_of[too_long] = len(buckets) - 1
+
+    base_bs = cfg.base_batch_size
+    if base_bs is None:
+        base_bs = max(cfg.max_tokens_per_batch // buckets[-1], 1)
+
+    batches: List[VariableBatch] = []
+    for bi, L in enumerate(buckets):
+        ids = np.where(bucket_of == bi)[0]
+        if not len(ids):
+            continue
+        if shuffle:
+            ids = rng.permutation(ids)
+        bs = max(cfg.max_tokens_per_batch // L, 1)
+        for s in range(0, len(ids), bs):
+            chunk = ids[s:s + bs]
+            if len(chunk) < cfg.min_batch_size:
+                continue
+            batches.append(VariableBatch(
+                sample_ids=chunk, seqlen=L,
+                lr_scale=lr_scale_for_batch(len(chunk), base_bs,
+                                            cfg.lr_scaling_method)))
+    if shuffle:
+        order = rng.permutation(len(batches))
+        batches = [batches[i] for i in order]
+    return batches
+
+
+class VariableBatchLoader:
+    """Iterate an indexed dataset as padded (input_ids, loss_mask, lr_scale)
+    batches under a token budget. Pads to the bucket length; masks padding."""
+
+    def __init__(self, dataset, cfg: VariableBatchConfig,
+                 pad_token_id: int = 0):
+        self.dataset = dataset
+        self.cfg = cfg
+        self.pad = pad_token_id
+        self.epoch = 0
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        seqlens = np.asarray([len(self.dataset[i])
+                              for i in range(len(self.dataset))])
+        for b in batch_by_token_budget(seqlens, self.cfg, epoch=self.epoch):
+            bs, L = len(b.sample_ids), b.seqlen
+            ids = np.full((bs, L), self.pad, np.int64)
+            mask = np.zeros((bs, L), np.float32)
+            for r, sid in enumerate(b.sample_ids):
+                tok = np.asarray(self.dataset[int(sid)])[:L]
+                ids[r, :len(tok)] = tok
+                mask[r, :len(tok)] = 1.0
+            yield {"input_ids": ids, "loss_mask": mask,
+                   "lr_scale": np.float32(b.lr_scale)}
+        self.epoch += 1

@@ -362,7 +362,11 @@ class TrainingEngine:
         # validated in __init__: stage <= 2, no tp/sp/ep/pp, no offload
         qgz = cfg.zero_optimization.zero_quantized_gradients
 
-        def step_fn(state: EngineState, batch: Dict[str, jax.Array]):
+        def step_fn(state: EngineState, batch: Dict[str, jax.Array],
+                    lr_scale=None):
+            # lr_scale: per-batch LR multiplier from the variable-batch
+            # sampler (data_sampling/variable_batch_size_and_lr.py); None
+            # (the default trace) compiles the scale away entirely.
             rng, step_rng = jax.random.split(state.rng)
 
             # metrics pytree mirrors whatever the user's loss_fn returns
@@ -448,6 +452,8 @@ class TrainingEngine:
             def do_update(operand):
                 params, opt_state, grads = operand
                 updates, new_opt = optimizer.update(grads, opt_state, params)
+                if lr_scale is not None:
+                    updates = jax.tree.map(lambda u: u * lr_scale, updates)
                 new_params = optax.apply_updates(params, updates)
                 return new_params, new_opt
 
@@ -496,6 +502,8 @@ class TrainingEngine:
             # the reference's "scheduler not stepped on overflow" behavior
             metrics["lr"] = jnp.asarray(
                 self.lr_schedule(state.step - state.skipped_steps), jnp.float32)
+            if lr_scale is not None:
+                metrics["lr"] = metrics["lr"] * lr_scale
             metrics["overflow"] = (~finite).astype(jnp.float32)
             return new_state, metrics
 
@@ -540,11 +548,14 @@ class TrainingEngine:
 
         return jax.jit(step_fn)
 
-    def _train_batch_offloaded(self, placed) -> Dict[str, float]:
+    def _train_batch_offloaded(self, placed, lr_scale=None
+                               ) -> Dict[str, float]:
         lr = self.get_lr()  # pre-increment: the lr this update applies
+        if lr_scale is not None:
+            lr *= float(lr_scale)
         grads, metrics, rng = self._grad_step(self.state.params, placed,
                                               self.state.rng)
-        new_params = self.offloaded_optimizer.step(grads)
+        new_params = self.offloaded_optimizer.step(grads, lr_scale=lr_scale)
         new_params = jax.tree.map(
             lambda x, s: jax.device_put(x, s), new_params, self.param_shardings)
         self.state = EngineState(
@@ -568,19 +579,39 @@ class TrainingEngine:
     # data placement
     # ------------------------------------------------------------------
 
-    def _place_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+    def _place_batch(self, batch: Dict[str, np.ndarray],
+                     allow_variable: bool = False) -> Dict[str, jax.Array]:
         """Reshape a global batch (train_batch, ...) → (gas, micro_global, ...)
-        and place it sharded over (dp, fsdp) on the batch axis."""
+        and place it sharded over (dp, fsdp) on the batch axis.
+
+        ``allow_variable``: variable-batch mode (a batch carrying
+        ``lr_scale``) accepts any leading dim divisible by gas×dp — the
+        token-budget batcher bounds the set of distinct shapes, so the
+        compile cache stays bounded too."""
         gas = self.batch_config.gradient_accumulation_steps
         tb = self.batch_config.train_batch_size
 
         sp = self.topo.size("sp")
+        dp = self.topo.dp_world_size
 
         def place(x):
             x = np.asarray(x)
             if x.shape[0] != tb:
-                raise ConfigError(
-                    f"batch leading dim {x.shape[0]} != train_batch_size {tb}")
+                if not allow_variable:
+                    raise ConfigError(
+                        f"batch leading dim {x.shape[0]} != train_batch_size "
+                        f"{tb}")
+                if x.shape[0] % (gas * dp) != 0:
+                    raise ConfigError(
+                        f"variable batch leading dim {x.shape[0]} not "
+                        f"divisible by gas*dp = {gas}*{dp}")
+                tb_local = x.shape[0]
+                x = x.reshape((gas, tb_local // gas) + x.shape[1:])
+                spec = [None, ("dp", "fsdp")]
+                if sp > 1 and x.ndim >= 3 and x.shape[2] % sp == 0:
+                    spec.append("sp")
+                return jax.device_put(
+                    x, NamedSharding(self.topo.mesh, P(*spec)))
             x = x.reshape((gas, tb // gas) + x.shape[1:])
             # (gas, batch, seq, ...): batch over dp/fsdp; seq over sp when
             # sequence parallelism is on (reference: UlyssesSPDataLoaderAdapter
@@ -609,11 +640,19 @@ class TrainingEngine:
         Returns a Mapping (LazyMetrics): reads materialize floats; convert
         with ``dict(m)`` for serialization.  Not a dict instance."""
         self.tput.start()
-        placed = self._place_batch(batch)
+        lr_scale = None
+        if "lr_scale" in batch:  # variable-batch LR (data_sampling)
+            batch = dict(batch)
+            lr_scale = np.float32(batch.pop("lr_scale"))
+        placed = self._place_batch(batch, allow_variable=lr_scale is not None)
         if self.offload_enabled:
-            out = self._train_batch_offloaded(placed)
+            out = self._train_batch_offloaded(placed, lr_scale)
         else:
-            self.state, metrics = self._train_step(self.state, placed)
+            if lr_scale is None:
+                self.state, metrics = self._train_step(self.state, placed)
+            else:
+                self.state, metrics = self._train_step(self.state, placed,
+                                                       lr_scale)
             out = LazyMetrics(metrics)
         self.global_steps += 1
         will_read = self.monitor.enabled or (

@@ -69,8 +69,10 @@ class OffloadedOptimizer:
         self.opt_state = jax.jit(optimizer.init)(self.master)
         param_dtypes = self._param_dtypes
 
-        def update(grads, opt_state, master):
+        def update(grads, opt_state, master, lr_scale=None):
             updates, new_opt = optimizer.update(grads, opt_state, master)
+            if lr_scale is not None:  # variable-batch LR multiplier
+                updates = jax.tree.map(lambda u: u * lr_scale, updates)
             new_master = optax.apply_updates(master, updates)
             # device copy keeps each param's original dtype
             device_params = jax.tree.map(
@@ -137,13 +139,18 @@ class OffloadedOptimizer:
 
     # -- the step ------------------------------------------------------
 
-    def step(self, grads_device: Any) -> Any:
+    def step(self, grads_device: Any, lr_scale=None) -> Any:
         """grads (device, fp32) → new device params (compute dtype).
         Transfers ride host DMA; the update itself is XLA:CPU."""
         grads_host = jax.device_put(jax.device_get(grads_device), self.cpu)
         self.swap_in()
-        self.master, self.opt_state, device_params = self._update(
-            grads_host, self.opt_state, self.master)
+        if lr_scale is None:
+            self.master, self.opt_state, device_params = self._update(
+                grads_host, self.opt_state, self.master)
+        else:
+            self.master, self.opt_state, device_params = self._update(
+                grads_host, self.opt_state, self.master,
+                np.float32(lr_scale))
         out = device_params
         self.swap_out_async()
         return out

@@ -1,0 +1,219 @@
+"""Data sampling stack tests: mmap indexed datasets, DataAnalyzer
+map-reduce, variable batch + LR (reference model:
+tests/unit/runtime/test_data_efficiency.py + the data_sampling package)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+    DataAnalyzer, MMapIndexedDataset, MMapIndexedDatasetBuilder,
+    VariableBatchConfig, batch_by_token_budget, best_fitting_dtype,
+    make_builder)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+    samples_up_to_difficulty)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.variable_batch_size_and_lr import (  # noqa: E501
+    VariableBatchLoader, lr_scale_for_batch)
+
+
+def _build(tmp_path, samples, docs_every=None, dtype=np.int32, name="ds"):
+    prefix = str(tmp_path / name)
+    b = MMapIndexedDatasetBuilder(prefix, dtype=dtype)
+    for i, s in enumerate(samples):
+        b.add_item(s)
+        if docs_every and (i + 1) % docs_every == 0:
+            b.end_document()
+    b.finalize()
+    return prefix
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    samples = [rng.integers(0, 50000, size=rng.integers(3, 40))
+               for _ in range(17)]
+    prefix = _build(tmp_path, samples, docs_every=5)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 17
+    for i, s in enumerate(samples):
+        np.testing.assert_array_equal(ds[i], s.astype(np.int32))
+    np.testing.assert_array_equal(ds.sizes, [len(s) for s in samples])
+    # doc index: boundary every 5 samples + end cap
+    assert ds.num_docs >= 3
+    assert ds.doc_idx[0] == 0 and ds.doc_idx[-1] == 17
+
+
+def test_indexed_dataset_get_slice(tmp_path):
+    prefix = _build(tmp_path, [np.arange(100)])
+    ds = MMapIndexedDataset(prefix)
+    np.testing.assert_array_equal(ds.get(0, offset=10, length=5),
+                                  np.arange(10, 15))
+
+
+def test_best_fitting_dtype_and_builder_factory(tmp_path):
+    assert best_fitting_dtype(50000) == np.dtype(np.uint16)
+    assert best_fitting_dtype(200000) == np.dtype(np.int32)
+    b = make_builder(str(tmp_path / "v"), vocab_size=30000)
+    b.add_item([1, 2, 3])
+    b.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "v"))
+    assert ds.dtype == np.dtype(np.uint16)
+    np.testing.assert_array_equal(ds[0], [1, 2, 3])
+
+
+def test_builder_merge(tmp_path):
+    p1 = _build(tmp_path, [np.arange(4), np.arange(5)], docs_every=1,
+                name="a")
+    b = MMapIndexedDatasetBuilder(str(tmp_path / "m"))
+    b.add_item([7, 8])
+    b.end_document()
+    b.merge_file(p1)
+    b.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "m"))
+    assert len(ds) == 3
+    np.testing.assert_array_equal(ds[1], np.arange(4))
+    assert ds.doc_idx[-1] == 3
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    samples = [np.arange(n) for n in [5, 17, 3, 17, 9, 1, 17]]
+    prefix = _build(tmp_path, samples)
+    ds = MMapIndexedDataset(prefix)
+    an = DataAnalyzer(
+        ds, {"seqlen": lambda s: float(len(s)),
+             "total_tokens": lambda s: float(len(s))},
+        save_path=str(tmp_path / "idx"), num_workers=3,
+        metric_types={"total_tokens": "accumulate_value_over_samples"})
+    paths = an.run()
+    s2m = np.load(paths["seqlen"])
+    np.testing.assert_array_equal(s2m, [5, 17, 3, 17, 9, 1, 17])
+    total = np.load(paths["total_tokens"])
+    assert total == sum(len(s) for s in samples)
+    # curriculum query off the CSR index
+    easy = samples_up_to_difficulty(str(tmp_path / "idx"), "seqlen", 9)
+    assert sorted(easy.tolist()) == [0, 2, 4, 5]
+    hard = samples_up_to_difficulty(str(tmp_path / "idx"), "seqlen", 100)
+    assert sorted(hard.tolist()) == list(range(7))
+
+
+def test_data_analyzer_resume(tmp_path):
+    """Shard files are reused on re-run (crash resume)."""
+    prefix = _build(tmp_path, [np.arange(4)] * 8)
+    ds = MMapIndexedDataset(prefix)
+    calls = []
+
+    def metric(s):
+        calls.append(1)
+        return float(len(s))
+
+    an = DataAnalyzer(ds, {"m": metric}, save_path=str(tmp_path / "i"),
+                      num_workers=2)
+    an.run()
+    n_first = len(calls)
+    an2 = DataAnalyzer(ds, {"m": metric}, save_path=str(tmp_path / "i"),
+                       num_workers=2)
+    an2.run()
+    assert len(calls) == n_first  # map skipped entirely
+
+
+def test_lr_scale_rules():
+    assert lr_scale_for_batch(64, 16, "linear") == 4.0
+    assert lr_scale_for_batch(64, 16, "sqrt") == 2.0
+    assert lr_scale_for_batch(64, 16, "none") == 1.0
+    with pytest.raises(ValueError):
+        lr_scale_for_batch(1, 1, "bogus")
+
+
+def test_batch_by_token_budget_covers_all_samples_once():
+    rng = np.random.default_rng(1)
+    seqlens = rng.integers(10, 1000, size=500)
+    cfg = VariableBatchConfig(max_tokens_per_batch=4096,
+                              min_bucket_seqlen=128, seed=3)
+    batches = batch_by_token_budget(seqlens, cfg)
+    seen = np.concatenate([b.sample_ids for b in batches])
+    assert sorted(seen.tolist()) == list(range(500))  # exactly once
+    for b in batches:
+        assert len(b.sample_ids) * b.seqlen <= max(
+            cfg.max_tokens_per_batch, b.seqlen)  # budget respected
+        assert (seqlens[b.sample_ids] <= b.seqlen).all()  # fits the bucket
+
+
+def test_batch_shapes_are_bounded():
+    """The TPU contract: distinct (bs, L) shapes ≤ number of buckets."""
+    rng = np.random.default_rng(2)
+    seqlens = rng.integers(1, 2048, size=2000)
+    cfg = VariableBatchConfig(max_tokens_per_batch=8192, min_bucket_seqlen=128)
+    batches = batch_by_token_budget(seqlens, cfg)
+    full_shapes = {(len(b.sample_ids), b.seqlen) for b in batches
+                   if len(b.sample_ids) == cfg.max_tokens_per_batch // b.seqlen}
+    assert len(full_shapes) <= 5  # 128,256,512,1024,2048
+
+
+def test_variable_batch_loader(tmp_path):
+    samples = [np.arange(n) + 1 for n in [5, 200, 130, 7, 260]]
+    prefix = _build(tmp_path, samples)
+    ds = MMapIndexedDataset(prefix)
+    cfg = VariableBatchConfig(max_tokens_per_batch=512, min_bucket_seqlen=8,
+                              lr_scaling_method="linear")
+    out = list(VariableBatchLoader(ds, cfg))
+    got = set()
+    for b in out:
+        assert b["input_ids"].shape == b["loss_mask"].shape
+        assert b["lr_scale"] > 0
+        for row, mask in zip(b["input_ids"], b["loss_mask"]):
+            toks = row[mask > 0]
+            # identify the source sample by its first token run
+            got.add(len(toks))
+            assert (row[mask == 0] == 0).all()  # padding masked
+    assert got == {5, 200, 130, 7, 260}  # every sample appeared unpadded
+
+
+def test_engine_applies_lr_scale(devices):
+    """A batch carrying lr_scale=0 must leave params untouched; the logged
+    lr reflects the scale (engine wiring for variable-batch LR)."""
+    import jax
+    import deepspeed_tpu
+    from tests.simple_model import copy_task_batch, tiny_lm_spec
+
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "steps_per_print": 100}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_lm_spec(),
+                                               config=cfg)
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    before = jax.device_get(engine.state.params)
+    m = engine.train_batch(dict(batch, lr_scale=0.0))
+    assert m["lr"] == 0.0
+    after = jax.device_get(engine.state.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)
+    # and a scaled step still trains
+    m2 = engine.train_batch(dict(batch, lr_scale=0.5))
+    assert m2["lr"] == pytest.approx(0.005, rel=1e-5)
+    after2 = jax.device_get(engine.state.params)
+    assert any((np.asarray(a) != np.asarray(b)).any()
+               for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(after2)))
+
+
+def test_engine_accepts_variable_batch_sizes(devices):
+    """Batches under a token budget have bucket-dependent sizes; the engine
+    must accept any lr_scale-carrying batch whose size divides gas*dp."""
+    import deepspeed_tpu
+    from tests.simple_model import copy_task_batch, tiny_lm_spec
+
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "steps_per_print": 100}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_lm_spec(),
+                                               config=cfg)
+    rng = np.random.default_rng(0)
+    tb = engine.train_batch_size
+    losses = []
+    for bs in (tb, tb // 2, tb * 2, tb // 2):  # bucket ladder
+        batch = copy_task_batch(rng, bs, 32)
+        m = engine.train_batch(dict(batch, lr_scale=bs / tb))
+        losses.append(m["loss"])
+    assert losses[-1] < losses[0]  # still learning across shapes
+    # without lr_scale, a mis-sized batch is still rejected loudly
+    from deepspeed_tpu.runtime.config_utils import ConfigError
+    with pytest.raises(ConfigError):
+        engine.train_batch(copy_task_batch(rng, tb // 2, 32))

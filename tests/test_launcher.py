@@ -266,23 +266,24 @@ m = os.environ["DSTPU_ELASTIC_MEMBER"]
 open(r"{marker}" + "/" + m + "-r" + os.environ["DSTPU_RESTART_COUNT"], "w").close()
 if m == "bad":
     sys.exit(1)
-time.sleep(0.3)
+time.sleep(1.0)
 """)
     agent = ElasticAgent(
         [sys.executable, str(script)],
         members_fn=lambda: ["good1", "bad", "good2"],  # static: bad re-listed
         agent_config=AgentConfig(max_restarts=12, poll_interval_s=0.1,
-                                 term_timeout_s=2.0, member_max_fails=2))
+                                 term_timeout_s=2.0, member_max_fails=2,
+                                 rejoin_cooldown_s=0.15))
     rc = agent.run()
     assert rc == 0
     assert "bad" in agent.banned  # struck out after member_max_fails crashes
     runs = {p.name for p in marker.iterdir()}
     assert "bad-r0" in runs
-    # each crash costs one sit-out restart + one rejoin restart; after the
-    # second crash the member is banned and never launched again
+    # crash → cool-down restart without bad → rejoin restart with bad →
+    # second crash → banned; never launched again
     bad_runs = {r for r in runs if r.startswith("bad-")}
     assert len(bad_runs) == 2, bad_runs
-    assert agent.restart_count <= 5
+    assert agent.restart_count <= 4
 
 
 def test_elastic_agent_survives_cascading_crash(tmp_path):
