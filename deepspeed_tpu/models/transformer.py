@@ -367,31 +367,34 @@ def _lin(x, p, w_key, b_key):
 
 
 def _attention_block(x, p, cfg: TransformerConfig, cos, sin, attn_fn: AttentionFn):
-    B, S, h = x.shape
-    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-    dt = x.dtype
-    q = _lin(x, p, "wq", "bq").reshape(B, S, nh, hd)
-    k = _lin(x, p, "wk", "bk").reshape(B, S, nkv, hd)
-    v = _lin(x, p, "wv", "bv").reshape(B, S, nkv, hd)
-    if cfg.position == "rope":
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-    o = attn_fn(q, k, v, causal=True)
-    return _lin(o.reshape(B, S, nh * hd), p, "wo", "bo")
+    # named scopes feed the flops profiler's per-module census
+    with jax.named_scope("attn"):
+        B, S, h = x.shape
+        nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        dt = x.dtype
+        q = _lin(x, p, "wq", "bq").reshape(B, S, nh, hd)
+        k = _lin(x, p, "wk", "bk").reshape(B, S, nkv, hd)
+        v = _lin(x, p, "wv", "bv").reshape(B, S, nkv, hd)
+        if cfg.position == "rope":
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+        o = attn_fn(q, k, v, causal=True)
+        return _lin(o.reshape(B, S, nh * hd), p, "wo", "bo")
 
 
 def _mlp_block(x, p, cfg: TransformerConfig):
-    if cfg.activation == "silu":
-        return _lin(jax.nn.silu(_lin(x, p, "w_gate", "b_gate"))
-                    * _lin(x, p, "w_in", "b_in"), p, "w_out", "b_out")
-    mid = _lin(x, p, "w_in", "b_in")
-    if cfg.activation == "relu":
-        mid = jax.nn.relu(mid)
-    elif cfg.activation == "gelu_exact":  # erf form (falcon/gpt-neox/phi)
-        mid = jax.nn.gelu(mid, approximate=False)
-    else:  # 'gelu': tanh approximation (gpt2's gelu_new)
-        mid = jax.nn.gelu(mid, approximate=True)
-    return _lin(mid, p, "w_out", "b_out")
+    with jax.named_scope("mlp"):
+        if cfg.activation == "silu":
+            return _lin(jax.nn.silu(_lin(x, p, "w_gate", "b_gate"))
+                        * _lin(x, p, "w_in", "b_in"), p, "w_out", "b_out")
+        mid = _lin(x, p, "w_in", "b_in")
+        if cfg.activation == "relu":
+            mid = jax.nn.relu(mid)
+        elif cfg.activation == "gelu_exact":  # erf form (falcon/gpt-neox/phi)
+            mid = jax.nn.gelu(mid, approximate=False)
+        else:  # 'gelu': tanh approximation (gpt2's gelu_new)
+            mid = jax.nn.gelu(mid, approximate=True)
+        return _lin(mid, p, "w_out", "b_out")
 
 
 def _remat_policy(name: str):
@@ -432,9 +435,10 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
             attn_fn = partial(attn_fn, window=cfg.sliding_window)
     B, S = tokens.shape
 
-    x = params["embed"]["tokens"].astype(dt)[tokens]
-    if cfg.position == "learned":
-        x = x + params["embed"]["position"].astype(dt)[None, :S]
+    with jax.named_scope("embed"):
+        x = params["embed"]["tokens"].astype(dt)[tokens]
+        if cfg.position == "learned":
+            x = x + params["embed"]["position"].astype(dt)[None, :S]
     cos, sin = (None, None)
     if cfg.position == "rope":
         cos, sin = rope_table(S, cfg.rot_dim, cfg.rope_theta)
@@ -473,7 +477,8 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
     if policy is not None:
         body = jax.checkpoint(layer_body, policy=policy, prevent_cse=False)
 
-    x, _ = lax.scan(body, x, params["layers"])
+    with jax.named_scope("layers"):
+        x, _ = lax.scan(body, x, params["layers"])
 
     return _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
 
@@ -484,10 +489,11 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: TransformerConfig,
     """tokens (B, S) int32 → logits (B, S, V) in compute dtype."""
     dt = jnp.dtype(cfg.dtype)
     x = forward_hidden(params, tokens, cfg, attn_fn=attn_fn, moe_fn=moe_fn)
-    if cfg.tie_embeddings:
-        logits = x @ params["embed"]["tokens"].astype(dt).T
-    else:
-        logits = x @ params["lm_head"]["w"].astype(dt)
+    with jax.named_scope("lm_head"):
+        if cfg.tie_embeddings:
+            logits = x @ params["embed"]["tokens"].astype(dt).T
+        else:
+            logits = x @ params["lm_head"]["w"].astype(dt)
     return logits
 
 

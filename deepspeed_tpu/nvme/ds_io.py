@@ -1,0 +1,197 @@
+"""NVMe benchmark + tuning CLI (``dstpu_io``).
+
+Capability analogue of the reference's DeepNVMe user tools
+(``deepspeed/nvme/io_engine.py`` multiprocess benchmark,
+``perf_run_sweep.py`` parameter sweep, ``perf_generate_param.py`` which
+distills the sweep into the aio config block, and the ``ds_io`` CLI).
+ZeRO-Infinity's swap bandwidth is decided by (block_size, queue_depth,
+thread_count, O_DIRECT) — this tool measures the actual device so the
+numbers in ``AIOConfig`` are empirical, not folklore.
+
+TPU-first note: there is no GDS analogue — device HBM is reached through
+the runtime, so the host-side AIO path (csrc/aio/ds_aio.cpp thread pool)
+is the whole story; the sweep therefore only tunes host↔NVMe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import itertools
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import logger
+from .aio_handle import AsyncIOHandle, aio_available
+
+
+@dataclasses.dataclass
+class IOBenchResult:
+    op: str  # 'read' | 'write'
+    gbps: float
+    seconds: float
+    size_bytes: int
+    block_size: int
+    queue_depth: int
+    thread_count: int
+    use_direct: bool
+
+    def as_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def _make_file(path: str, nbytes: int) -> None:
+    chunk = np.random.randint(0, 255, size=min(nbytes, 1 << 24),
+                              dtype=np.uint8)
+    with open(path, "wb") as f:
+        left = nbytes
+        while left > 0:
+            f.write(chunk[:left].tobytes())
+            left -= min(left, chunk.nbytes)
+
+
+def run_bench(path: str, op: str = "read", size_mb: int = 256,
+              block_size: int = 1 << 20, queue_depth: int = 8,
+              thread_count: int = 4, use_direct: bool = False,
+              keep_file: bool = False) -> IOBenchResult:
+    """One measurement: stream ``size_mb`` through the AIO handle split into
+    queue_depth in-flight slices (the reference's single-process ds_io job)."""
+    nbytes = size_mb << 20
+    handle = AsyncIOHandle(block_size=block_size, queue_depth=queue_depth,
+                           thread_count=thread_count, use_direct=use_direct)
+    created = False
+    if op == "read" and (not os.path.exists(path)
+                         or os.path.getsize(path) < nbytes):
+        # a stale smaller file would short-read past EOF and report
+        # fantasy bandwidth — always (re)create to full size
+        _make_file(path, nbytes)
+        created = True
+    buf = np.empty(nbytes, np.uint8)
+    slices = max(queue_depth, 1)
+    per = nbytes // slices
+    t0 = time.perf_counter()
+    reqs = []
+    for i in range(slices):
+        end = nbytes if i == slices - 1 else (i + 1) * per  # + remainder
+        view = buf[i * per:end]
+        if op == "read":
+            reqs.append(handle.pread(path, view, file_offset=i * per))
+        else:
+            reqs.append(handle.pwrite(path, view, file_offset=i * per))
+    handle.wait_all()
+    dt = time.perf_counter() - t0
+    if not keep_file and (op == "write" or created):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return IOBenchResult(op=op, gbps=nbytes / dt / 1e9, seconds=dt,
+                         size_bytes=nbytes, block_size=block_size,
+                         queue_depth=queue_depth, thread_count=thread_count,
+                         use_direct=use_direct)
+
+
+def run_sweep(dir_path: str, op: str = "read", size_mb: int = 128,
+              block_sizes: Sequence[int] = (1 << 18, 1 << 20, 1 << 22),
+              queue_depths: Sequence[int] = (4, 8, 16),
+              thread_counts: Sequence[int] = (1, 2, 4, 8),
+              use_direct: bool = False) -> List[IOBenchResult]:
+    """Grid sweep (reference: ``perf_run_sweep.py``); returns results sorted
+    fastest-first."""
+    os.makedirs(dir_path, exist_ok=True)
+    path = os.path.join(dir_path, "dstpu_io_bench.dat")
+    if op == "read":
+        _make_file(path, size_mb << 20)
+    results = []
+    for bs, qd, tc in itertools.product(block_sizes, queue_depths,
+                                        thread_counts):
+        try:
+            r = run_bench(path, op=op, size_mb=size_mb, block_size=bs,
+                          queue_depth=qd, thread_count=tc,
+                          use_direct=use_direct, keep_file=True)
+        except OSError as e:  # e.g. O_DIRECT unsupported on this fs
+            logger.warning(f"sweep point bs={bs} qd={qd} tc={tc} failed: {e}")
+            continue
+        results.append(r)
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    return sorted(results, key=lambda r: -r.gbps)
+
+
+def generate_aio_config(results: Sequence[IOBenchResult]) -> Dict:
+    """Best sweep point → the ``aio`` config block the engine consumes
+    (reference: ``perf_generate_param.py`` → ds_config['aio'])."""
+    if not results:
+        raise ValueError("empty sweep")
+    best = results[0]
+    return {
+        "aio": {
+            "block_size": best.block_size,
+            "queue_depth": best.queue_depth,
+            "thread_count": best.thread_count,
+            "single_submit": False,
+            "overlap_events": True,
+        },
+        "measured_GB_per_sec": round(best.gbps, 3),
+        "op": best.op,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dstpu_io",
+        description="NVMe benchmark/tuner for ZeRO-Infinity swap paths")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    b = sub.add_parser("bench", help="single measurement")
+    b.add_argument("--path", default=os.path.join(tempfile.gettempdir(),
+                                                  "dstpu_io_bench.dat"))
+    b.add_argument("--op", choices=["read", "write"], default="read")
+    b.add_argument("--size_mb", type=int, default=256)
+    b.add_argument("--block_size", type=int, default=1 << 20)
+    b.add_argument("--queue_depth", type=int, default=8)
+    b.add_argument("--threads", type=int, default=4)
+    b.add_argument("--direct", action="store_true")
+
+    s = sub.add_parser("sweep", help="grid sweep → recommended aio config")
+    s.add_argument("--dir", default=tempfile.gettempdir())
+    s.add_argument("--op", choices=["read", "write"], default="read")
+    s.add_argument("--size_mb", type=int, default=128)
+    s.add_argument("--direct", action="store_true")
+
+    args = p.parse_args(argv)
+    if not aio_available():
+        print("AIO library unavailable (g++ build failed?)", file=sys.stderr)
+        return 1
+
+    if args.cmd == "bench":
+        r = run_bench(args.path, op=args.op, size_mb=args.size_mb,
+                      block_size=args.block_size,
+                      queue_depth=args.queue_depth,
+                      thread_count=args.threads, use_direct=args.direct)
+        print(json.dumps(r.as_dict()))
+        return 0
+
+    results = run_sweep(args.dir, op=args.op, size_mb=args.size_mb,
+                        use_direct=args.direct)
+    if not results:
+        print("every sweep point failed (O_DIRECT unsupported on this "
+              "filesystem?) — retry without --direct", file=sys.stderr)
+        return 1
+    for r in results[:10]:
+        print(f"  {r.gbps:6.2f} GB/s  bs={r.block_size:>8} "
+              f"qd={r.queue_depth:>3} threads={r.thread_count}")
+    print(json.dumps(generate_aio_config(results)))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -20,6 +20,7 @@ map/reduce split the reference implements with torch multiprocessing.
 
 from __future__ import annotations
 
+import json
 import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence
@@ -55,10 +56,29 @@ class DataAnalyzer:
     def _shard_path(self, metric: str, shard: int) -> str:
         return os.path.join(self.save_path, f"{metric}_shard{shard}.npy")
 
+    def _check_manifest(self, n: int) -> None:
+        """Shard files are only valid for the (num_workers, dataset size)
+        that produced them; a mismatched resume silently misaligns sample
+        ids, so it is an error."""
+        path = os.path.join(self.save_path, "manifest.json")
+        current = {"num_workers": self.num_workers, "num_samples": n}
+        if os.path.exists(path):
+            with open(path) as f:
+                prior = json.load(f)
+            if prior != current:
+                raise ValueError(
+                    f"analyzer resume mismatch: save_path was written with "
+                    f"{prior}, current run is {current}; use a fresh "
+                    f"save_path or the same worker count")
+        else:
+            with open(path, "w") as f:
+                json.dump(current, f)
+
     def run_map(self) -> None:
         """Compute metric values for every sample, sharded over workers.
         Idempotent: existing shard files are kept (crash resume)."""
         n = len(self.dataset)
+        self._check_manifest(n)
         bounds = np.linspace(0, n, self.num_workers + 1, dtype=np.int64)
 
         def work(shard: int) -> None:
@@ -84,10 +104,15 @@ class DataAnalyzer:
         """Merge shards into the final index files; returns metric → path
         of the sample_to_metric (or accumulated) artifact."""
         out: Dict[str, str] = {}
+        n = len(self.dataset)
         for m in self.metric_fns:
             shards = [np.load(self._shard_path(m, s))
                       for s in range(self.num_workers)]
             merged = np.concatenate(shards) if shards else np.empty(0)
+            if len(merged) != n:
+                raise ValueError(
+                    f"metric {m!r}: merged length {len(merged)} != dataset "
+                    f"size {n} (stale shards from a different run?)")
             kind = self.metric_types.get(m, "single_value_per_sample")
             if kind == "accumulate_value_over_samples":
                 path = os.path.join(self.save_path, f"{m}_accumulated.npy")

@@ -37,6 +37,11 @@ class VariableBatchConfig:
     base_batch_size: Optional[int] = None
     #: drop batches smaller than this (stragglers at bucket tails)
     min_batch_size: int = 1
+    #: round every batch size DOWN to a multiple of this (set to
+    #: gradient_accumulation_steps × dp_world_size so batches divide the
+    #: engine's data-parallel placement; excess samples join the next batch
+    #: or are dropped at the bucket tail)
+    batch_size_multiple: int = 1
     seed: int = 0
 
 
@@ -99,6 +104,7 @@ def batch_by_token_budget(seqlens: Sequence[int], cfg: VariableBatchConfig,
     if base_bs is None:
         base_bs = max(cfg.max_tokens_per_batch // buckets[-1], 1)
 
+    mult = max(cfg.batch_size_multiple, 1)
     batches: List[VariableBatch] = []
     for bi, L in enumerate(buckets):
         ids = np.where(bucket_of == bi)[0]
@@ -107,9 +113,12 @@ def batch_by_token_budget(seqlens: Sequence[int], cfg: VariableBatchConfig,
         if shuffle:
             ids = rng.permutation(ids)
         bs = max(cfg.max_tokens_per_batch // L, 1)
+        bs = max(bs // mult * mult, mult)  # divisible by gas*dp
         for s in range(0, len(ids), bs):
             chunk = ids[s:s + bs]
-            if len(chunk) < cfg.min_batch_size:
+            if len(chunk) % mult != 0:  # tail: trim to the multiple
+                chunk = chunk[:len(chunk) // mult * mult]
+            if len(chunk) < max(cfg.min_batch_size, 1):
                 continue
             batches.append(VariableBatch(
                 sample_ids=chunk, seqlen=L,

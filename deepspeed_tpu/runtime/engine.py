@@ -605,14 +605,7 @@ class TrainingEngine:
                     raise ConfigError(
                         f"variable batch leading dim {x.shape[0]} not "
                         f"divisible by gas*dp = {gas}*{dp}")
-                tb_local = x.shape[0]
-                x = x.reshape((gas, tb_local // gas) + x.shape[1:])
-                spec = [None, ("dp", "fsdp")]
-                if sp > 1 and x.ndim >= 3 and x.shape[2] % sp == 0:
-                    spec.append("sp")
-                return jax.device_put(
-                    x, NamedSharding(self.topo.mesh, P(*spec)))
-            x = x.reshape((gas, tb // gas) + x.shape[1:])
+            x = x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
             # (gas, batch, seq, ...): batch over dp/fsdp; seq over sp when
             # sequence parallelism is on (reference: UlyssesSPDataLoaderAdapter
             # shards dataloader batches on the sequence dim)

@@ -43,6 +43,40 @@ def test_engine_flops_profile(devices):
     assert res.step_time_s and res.step_time_s > 0
 
 
+
+def test_profile_fn_per_module_census(devices):
+    """Named-scope per-module breakdown with scan trip multipliers
+    (reference: print_model_profile per-module FLOPs tree)."""
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  init_params, loss_fn)
+    from deepspeed_tpu.profiling.flops_profiler import (aggregate_modules,
+                                                        profile_fn)
+
+    cfg = TransformerConfig(num_layers=3, hidden_size=64, num_heads=4,
+                            intermediate_size=256, vocab_size=128,
+                            max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch = {"input_ids": jnp.zeros((2, 64), jnp.int32)}
+    res = profile_fn(lambda p, b: loss_fn(p, b, cfg)[0], params, batch,
+                     params=params)
+    agg = aggregate_modules(res.per_module, depth=2)
+    assert any(k.startswith("layers/attn") for k in agg)
+    assert any(k.startswith("layers/mlp") for k in agg)
+    assert "lm_head" in agg
+    # scan multiplier: attn qkvo matmuls = L * 2*B*S*(4*h*h) exactly
+    B, S, h, L = 2, 64, cfg.hidden_size, cfg.num_layers
+    attn_matmul = 2 * B * S * (4 * h * h) * L
+    assert agg["layers/attn"]["flops"] >= attn_matmul  # + scores/rope/etc
+    # analytic total ≈ 2 * non-embed params * tokens (PaLM counting)
+    approx = 2 * cfg.num_params(include_embed=False) * B * S
+    assert res.analytic_flops == pytest.approx(approx, rel=0.35)
+    # the summary renders the module table
+    res.step_time_s = 0.01
+    out = res.summary(depth=2)
+    assert "layers/attn" in out and "est ms" in out
+    assert res.module_params  # per-subtree param counts
+    assert sum(res.module_params.values()) == res.params
+
 # ---------------------------------------------------------------------------
 # data efficiency
 # ---------------------------------------------------------------------------

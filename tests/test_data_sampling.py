@@ -217,3 +217,24 @@ def test_engine_accepts_variable_batch_sizes(devices):
     from deepspeed_tpu.runtime.config_utils import ConfigError
     with pytest.raises(ConfigError):
         engine.train_batch(copy_task_batch(rng, tb // 2, 32))
+
+
+def test_batch_size_multiple_rounds_batches():
+    rng = np.random.default_rng(4)
+    seqlens = rng.integers(10, 500, size=333)
+    cfg = VariableBatchConfig(max_tokens_per_batch=4096, min_bucket_seqlen=64,
+                              batch_size_multiple=8)
+    batches = batch_by_token_budget(seqlens, cfg)
+    assert batches, "no batches survived rounding"
+    for b in batches:
+        assert len(b.sample_ids) % 8 == 0
+
+
+def test_analyzer_rejects_mismatched_resume(tmp_path):
+    prefix = _build(tmp_path, [np.arange(4)] * 8)
+    ds = MMapIndexedDataset(prefix)
+    DataAnalyzer(ds, {"m": lambda s: 1.0}, save_path=str(tmp_path / "i"),
+                 num_workers=2).run()
+    with pytest.raises(ValueError, match="resume mismatch"):
+        DataAnalyzer(ds, {"m": lambda s: 1.0}, save_path=str(tmp_path / "i"),
+                     num_workers=4).run()

@@ -136,3 +136,39 @@ def test_offload_resume_continues_identically(devices, tmp_path):
     e2.load_checkpoint(str(tmp_path / "ck"))
     resumed = [e2.train_batch(batch)["loss"] for _ in range(2)]
     np.testing.assert_allclose(resumed, after_more, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# ds_io benchmark/tuning CLI (reference: deepspeed/nvme io_engine + sweep)
+# ---------------------------------------------------------------------------
+
+
+def test_ds_io_bench_and_sweep(tmp_path):
+    from deepspeed_tpu.nvme.ds_io import (generate_aio_config, run_bench,
+                                          run_sweep)
+
+    r = run_bench(str(tmp_path / "f.dat"), op="write", size_mb=8,
+                  block_size=1 << 18, queue_depth=4, thread_count=2)
+    assert r.gbps > 0 and r.size_bytes == 8 << 20
+
+    results = run_sweep(str(tmp_path), op="read", size_mb=4,
+                        block_sizes=[1 << 18], queue_depths=[2, 4],
+                        thread_counts=[1, 2])
+    assert len(results) == 4
+    assert results[0].gbps >= results[-1].gbps  # sorted fastest-first
+    cfg = generate_aio_config(results)
+    assert cfg["aio"]["queue_depth"] in (2, 4)
+    assert cfg["measured_GB_per_sec"] > 0
+
+
+def test_ds_io_cli(tmp_path, capsys):
+    import json as _json
+
+    from deepspeed_tpu.nvme.ds_io import main
+
+    rc = main(["bench", "--path", str(tmp_path / "c.dat"), "--op", "write",
+               "--size_mb", "4", "--queue_depth", "2", "--threads", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    d = _json.loads(out)
+    assert d["op"] == "write" and d["gbps"] > 0

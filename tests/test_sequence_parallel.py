@@ -90,3 +90,26 @@ def test_sp_training_end_to_end(devices, impl):
     batch = copy_task_batch(rng, engine.train_batch_size, 32)
     losses = [engine.train_batch(batch)["loss"] for _ in range(10)]
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_min_kv_replication_factor():
+    from deepspeed_tpu.sequence.ulysses import min_kv_replication
+
+    # KV=8, sp=16, H=64: lcm path needs 2x, full expansion would be 8x
+    assert min_kv_replication(64, 8, 16) == 2
+    assert min_kv_replication(32, 8, 16) == 2
+    # already divisible: no-op factor
+    assert min_kv_replication(16, 8, 8) == 1
+    # group not divisible by the minimal rep → full expansion fallback
+    assert min_kv_replication(12, 4, 8) == 3
+
+
+def test_ulysses_gqa_minimal_replication_numerics(sp_topo):
+    """GQA with KV < sp: minimal replication must match the dense reference."""
+    B, S, H, D, KV = 1, 64, 16, 8, 2  # sp=8: rep=4 < H/KV=8
+    q, k, v = _qkv(jax.random.PRNGKey(7), B=B, S=S, H=H, D=D, KV=KV)
+    out = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, causal=True, attn_fn=xla_attention))(q, k, v)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
