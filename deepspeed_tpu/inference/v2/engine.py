@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models import transformer as tfm
-from .ragged import (KVCacheManager, RaggedBatch, RaggedBatchBuilder,
+from .ragged import (DecodeStateTable, KVCacheManager, RaggedBatch,
+                     RaggedBatchBuilder,
                      SequenceDescriptor)
 
 
@@ -312,6 +313,14 @@ class InferenceEngineV2:
         self._multi_decode = {}  # num_steps -> jitted burst decoder
         self.running: Dict[int, SequenceDescriptor] = {}
         self.waiting: Deque[SequenceDescriptor] = deque()
+        # SoA decode state: the steady-state (all-decode) path reads/writes
+        # these arrays with vectorized ops instead of walking descriptors
+        # (VERDICT weak #7: Python-per-step scheduler)
+        self.table = DecodeStateTable(
+            self.cfg.max_seqs, self.cfg.max_blocks_per_seq,
+            self.cfg.max_blocks_per_seq * self.cfg.block_size)
+        self._prefilling = 0  # running seqs still before their first token
+        self.fast_steps = 0  # telemetry: SoA decode steps taken
         self._uid = 0
         self._rng = jax.random.PRNGKey(0)
 
@@ -356,14 +365,79 @@ class InferenceEngineV2:
                 break
             self.waiting.popleft()
             self.running[seq.uid] = seq
+            self.table.admit(seq)
+            self._prefilling += 1
             picks.append((seq, n))
             budget -= n
         return picks
+
+    def _flush_table(self) -> None:
+        """Re-sync descriptors from the SoA rows before any descriptor-based
+        (mixed prefill/decode) step."""
+        for seq in self.running.values():
+            self.table.flush_tokens(seq)
+
+    def _finish(self, seq: SequenceDescriptor) -> None:
+        seq.done = True
+        self.table.retire(seq)
+        self.kv.release(seq)
+        del self.running[seq.uid]
+
+    def _table_inputs(self):
+        """Decode dispatch inputs straight off the SoA table (padded static
+        shapes; inactive rows carry ctx 0)."""
+        t = self.table
+        ctx_in = ((t.ctx + 1) * t.active).astype(np.int32)
+        return (jnp.asarray(t.next_tok), jnp.asarray(t.ctx),
+                jnp.asarray(t.block_tables), jnp.asarray(ctx_in))
+
+    def _advance_rows(self, sel: "np.ndarray") -> "np.ndarray":
+        """Vectorized post-decode bookkeeping. ``sel``: (k, ns) new tokens
+        for the active rows; retires sequences whose budget is exhausted;
+        returns the active row indices."""
+        t = self.table
+        rows = np.nonzero(t.active)[0]
+        k = sel.shape[0]
+        t.hist[rows[:, None],
+               t.hist_len[rows][:, None] + np.arange(k)[None, :]] = sel.T
+        t.hist_len[rows] += k
+        t.next_tok[rows] = sel[-1]
+        t.ctx[rows] += k
+        t.gen[rows] += k
+        for r in rows[t.gen[rows] >= t.budget[rows]]:
+            self._finish(t.seq_at[int(r)])
+        return rows
+
+    def _decode_step_fast(self, temperature: float,
+                          rng: Optional[jax.Array]) -> Dict[int, int]:
+        """Steady-state decode: inputs ARE the table arrays; bookkeeping is
+        vectorized; Python touches only sequences that just completed."""
+        self.fast_steps += 1
+        t = self.table
+        logits, self.caches = self._decode_fwd(
+            self.params, self.caches, *self._table_inputs())
+        if temperature > 0.0:
+            if rng is None:
+                self._rng, rng = jax.random.split(self._rng)
+            sampled = jax.random.categorical(rng, logits / temperature,
+                                             axis=-1)
+        else:
+            sampled = logits.argmax(-1)
+        sampled = np.asarray(sampled)
+        rows = np.nonzero(t.active)[0]
+        sel = sampled[rows].astype(np.int32)[None, :]  # (1, ns)
+        out = {t.seq_at[int(r)].uid: int(s) for r, s in zip(rows, sel[0])}
+        self._advance_rows(sel)
+        return out
 
     def step(self, temperature: float = 0.0, rng: Optional[jax.Array] = None
              ) -> Dict[int, int]:
         """One continuous-batching step → {uid: new_token} for sequences that
         produced a token (prefill-finished or decode)."""
+        if not self.waiting and self.running and self._prefilling == 0:
+            # steady state: every running sequence is decoding — SoA path
+            return self._decode_step_fast(temperature, rng)
+        self._flush_table()
         picks = self._schedule()
         if not picks:
             if self.running:
@@ -371,19 +445,12 @@ class InferenceEngineV2:
                     "scheduler made no progress with running sequences — "
                     "KV reservation invariant violated (bug)")
             return {}
-        pure_decode = all(n == 1 and s.seen_tokens > 0 for s, n in picks)
-        if pure_decode:
-            # hot path: one token per sequence through the paged Pallas kernel
-            tok, pos, bt, ctx = self._decode_inputs(picks)
-            logits, self.caches = self._decode_fwd(
-                self.params, self.caches, tok, pos, bt, ctx)
-        else:
-            batch = self.builder.build(picks)
-            logits, self.caches = self._fwd(
-                self.params, self.caches,
-                jnp.asarray(batch.token_ids), jnp.asarray(batch.position_ids),
-                jnp.asarray(batch.seq_index), jnp.asarray(batch.block_tables),
-                jnp.asarray(batch.context_lens), jnp.asarray(batch.logits_rows))
+        batch = self.builder.build(picks)
+        logits, self.caches = self._fwd(
+            self.params, self.caches,
+            jnp.asarray(batch.token_ids), jnp.asarray(batch.position_ids),
+            jnp.asarray(batch.seq_index), jnp.asarray(batch.block_tables),
+            jnp.asarray(batch.context_lens), jnp.asarray(batch.logits_rows))
         if temperature > 0.0:
             if rng is None:
                 self._rng, rng = jax.random.split(self._rng)
@@ -400,54 +467,32 @@ class InferenceEngineV2:
                 seq.tokens.append(tok)
                 seq.generated += 1
                 out[seq.uid] = tok
+                if not seq.in_decode:
+                    seq.in_decode = True
+                    self._prefilling -= 1
                 if seq.generated >= seq.max_new_tokens:
-                    seq.done = True
-                    self.kv.release(seq)
-                    del self.running[seq.uid]
+                    self._finish(seq)
+            if seq.uid in self.table.row_of:
+                self.table.sync(seq)
         return out
-
-    def _decode_inputs(self, picks):
-        """Padded (tok, pos, block_tables, context_lens) for pure-decode
-        dispatch — shared by step() and _burst_decode."""
-        batch = self.builder.build(picks)
-        ns = len(picks)
-        tok = np.zeros(self.cfg.max_seqs, np.int32)
-        pos = np.zeros(self.cfg.max_seqs, np.int32)
-        tok[:ns] = batch.token_ids[:ns]
-        pos[:ns] = batch.position_ids[:ns]
-        return (jnp.asarray(tok), jnp.asarray(pos),
-                jnp.asarray(batch.block_tables),
-                jnp.asarray(batch.context_lens))
 
     def _burst_decode(self, k: int, temperature: float = 0.0,
                       rng: Optional[jax.Array] = None) -> None:
         """Decode ``k`` tokens for every running sequence in one jitted
-        program (multi-token decode; host loop eliminated)."""
-        picks = [(s, 1) for s in self.running.values()]
-        for s, _ in picks:  # blocks were reserved at admission
-            if not self.kv.ensure_capacity(s, k):
-                raise RuntimeError(
-                    "burst decode capacity invariant violated: admission must "
-                    "reserve the full block budget")
+        program (multi-token decode; host loop eliminated). Bookkeeping is
+        vectorized over the SoA table (blocks were reserved at admission)."""
         if k not in self._multi_decode:
             self._multi_decode[k] = build_multi_decode_forward(
                 self.model_cfg, self.cfg, k)
-        tok, pos, bt, ctx = self._decode_inputs(picks)
+        t = self.table
         if rng is None:
             self._rng, rng = jax.random.split(self._rng)
         toks, self.caches = self._multi_decode[k](
-            self.params, self.caches, tok, pos, bt, ctx, rng,
+            self.params, self.caches, *self._table_inputs(), rng,
             jnp.asarray(temperature, jnp.float32))
         toks = np.asarray(toks)  # (k, max_seqs)
-        for row, (seq, _) in enumerate(picks):
-            new = toks[:, row].tolist()
-            seq.seen_tokens += k
-            seq.tokens.extend(new)
-            seq.generated += k
-            if seq.generated >= seq.max_new_tokens:
-                seq.done = True
-                self.kv.release(seq)
-                del self.running[seq.uid]
+        rows = np.nonzero(t.active)[0]
+        self._advance_rows(toks[:, rows].astype(np.int32))
 
     def generate_all(self, temperature: float = 0.0, seed: int = 0,
                      max_steps: int = 10000, burst: int = 8
@@ -461,13 +506,12 @@ class InferenceEngineV2:
         for _ in range(max_steps):
             if not self.waiting and not self.running:
                 break
+            t = self.table
             can_burst = (
                 burst > 1
                 and not self.waiting and self.running
-                and all(s.seen_tokens == s.cur_len - 1 and s.seen_tokens > 0
-                        for s in self.running.values())
-                and min(s.max_new_tokens - s.generated
-                        for s in self.running.values()) >= burst)
+                and self._prefilling == 0
+                and int((t.budget - t.gen)[t.active].min()) >= burst)
             if can_burst:
                 rng, burst_rng = jax.random.split(rng)
                 self._burst_decode(burst, temperature=temperature,
@@ -475,6 +519,7 @@ class InferenceEngineV2:
                 continue
             rng, step_rng = jax.random.split(rng)
             self.step(temperature=temperature, rng=step_rng)
+        self._flush_table()  # max_steps exhaustion: sync still-running seqs
         for uid, seq in tracked.items():
             results[uid] = seq.tokens
         return results

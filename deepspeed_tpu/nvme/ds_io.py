@@ -59,19 +59,29 @@ def _make_file(path: str, nbytes: int) -> None:
 def run_bench(path: str, op: str = "read", size_mb: int = 256,
               block_size: int = 1 << 20, queue_depth: int = 8,
               thread_count: int = 4, use_direct: bool = False,
-              keep_file: bool = False) -> IOBenchResult:
+              keep_file: bool = False,
+              overwrite: bool = False) -> IOBenchResult:
     """One measurement: stream ``size_mb`` through the AIO handle split into
     queue_depth in-flight slices (the reference's single-process ds_io job)."""
     nbytes = size_mb << 20
     handle = AsyncIOHandle(block_size=block_size, queue_depth=queue_depth,
                            thread_count=thread_count, use_direct=use_direct)
     created = False
-    if op == "read" and (not os.path.exists(path)
-                         or os.path.getsize(path) < nbytes):
-        # a stale smaller file would short-read past EOF and report
-        # fantasy bandwidth — always (re)create to full size
-        _make_file(path, nbytes)
-        created = True
+    if op == "read":
+        if not os.path.exists(path):
+            _make_file(path, nbytes)
+            created = True
+        elif os.path.getsize(path) < nbytes:
+            # a smaller file would short-read past EOF and report fantasy
+            # bandwidth; never overwrite a file we didn't create
+            raise ValueError(
+                f"{path} is {os.path.getsize(path)} bytes but the bench "
+                f"needs {nbytes}; point --path at a missing file (it will "
+                f"be created) or lower --size_mb")
+    elif os.path.exists(path) and not overwrite:
+        raise ValueError(
+            f"write bench refuses to overwrite existing {path}; point "
+            f"--path at a missing file")
     buf = np.empty(nbytes, np.uint8)
     slices = max(queue_depth, 1)
     per = nbytes // slices
@@ -114,7 +124,8 @@ def run_sweep(dir_path: str, op: str = "read", size_mb: int = 128,
         try:
             r = run_bench(path, op=op, size_mb=size_mb, block_size=bs,
                           queue_depth=qd, thread_count=tc,
-                          use_direct=use_direct, keep_file=True)
+                          use_direct=use_direct, keep_file=True,
+                          overwrite=True)
         except OSError as e:  # e.g. O_DIRECT unsupported on this fs
             logger.warning(f"sweep point bs={bs} qd={qd} tc={tc} failed: {e}")
             continue

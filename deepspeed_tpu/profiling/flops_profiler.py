@@ -91,6 +91,11 @@ def _sub_jaxprs(eqn) -> Tuple[list, float]:
     mult = 1.0
     if eqn.primitive.name == "scan":
         mult = float(eqn.params.get("length", 1))
+    elif eqn.primitive.name == "cond" and subs:
+        # exactly one branch executes; weight each by 1/n (expected cost
+        # under a uniform prior — exact when branches are cost-symmetric,
+        # and never the all-branches overcount)
+        mult = 1.0 / len(subs)
     # while_loop trip counts are data-dependent: counted once (documented)
     return subs, mult
 

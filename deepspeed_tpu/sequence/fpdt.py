@@ -4,15 +4,29 @@ attention with host offload of KV chunks).
 Capability analogue of the reference's Ulysses-Offload
 (``deepspeed/sequence/fpdt_layer.py`` — ``SequenceChunk:497``,
 ``_FPDTGPUOffloadingAttentionImpl_:545``): process an extreme-length sequence
-in chunks; completed KV chunks move to host memory and stream back per query
-chunk, so device memory holds O(chunk) instead of O(S) — 2M+ tokens on small
-device counts in the reference.
+in chunks; KV chunks live in host memory and stream back per query chunk, so
+device memory holds O(chunk) instead of O(S) — 2M+ tokens on small device
+counts in the reference.
 
-TPU-native form: ``lax.scan`` over query chunks with the KV history pinned to
-``pinned_host`` memory via sharding memory kinds; XLA overlaps the
-host↔device streams with the blockwise attention compute (the reference's
-double-buffered CUDA streams).  On backends without host memory-space support
-the same code runs with device-resident history (pure chunked attention).
+TPU-native form, three pieces replacing the reference's hand-rolled CUDA
+double-buffer streams:
+
+* the KV chunk stacks are placed in ``pinned_host`` memory *inside the
+  compiled program* (``jax.device_put`` with a memory-kind sharding — XLA's
+  memory-space assignment); the inner ``lax.scan`` then slices one chunk per
+  step and the latency-hiding scheduler overlaps the host→device DMA of
+  chunk j+1 with the attention compute of chunk j (the pipelining);
+* online-softmax accumulation across KV chunks (blockwise attention), with
+  strictly-future chunks skipped under causality;
+* sequence parallelism composes by GSPMD *resharding*: annotate q/k/v from
+  sequence-sharded to head-sharded and XLA inserts the all-to-all
+  (the reference's explicit a2a, derived by the compiler), then the chunked
+  scan runs on the head-sharded global view — so host offload and sp
+  compose in one program.
+
+Backward: each query-chunk step is wrapped in ``jax.checkpoint`` — the
+backward pass re-streams KV from host and recomputes the chunk's attention
+instead of storing per-chunk probability tiles.
 """
 
 from __future__ import annotations
@@ -24,55 +38,86 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P, SingleDeviceSharding
+
+from ..parallel.topology import get_topology, topology_initialized
 
 NEG_INF = -1e30
 
 
-def _host_sharding(x: jax.Array):
-    """Best-effort pinned-host placement for the KV history."""
+def _host_capable() -> bool:
     try:
-        dev = x.devices().pop() if hasattr(x, "devices") else jax.devices()[0]
-        sharding = jax.sharding.SingleDeviceSharding(
-            dev, memory_kind="pinned_host")
-        return sharding
+        dev = jax.devices()[0]
+        return any(m.kind == "pinned_host"
+                   for m in dev.addressable_memories())
     except Exception:
-        return None
+        return False
+
+
+def _put(x: jax.Array, kind: str, spec: Optional[P] = None,
+         mesh=None) -> jax.Array:
+    """In-graph placement into a memory space (no-op when the backend has
+    no host memory space)."""
+    if not _host_capable():
+        return x
+    if mesh is not None and spec is not None:
+        sh = NamedSharding(mesh, spec, memory_kind=kind)
+    else:
+        sh = SingleDeviceSharding(jax.devices()[0], memory_kind=kind)
+    return jax.device_put(x, sh)
 
 
 def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       chunk_size: int, causal: bool = True,
-                      offload_kv: bool = False) -> jax.Array:
+                      offload_kv: bool = False,
+                      kv_spec: Optional[P] = None, mesh=None,
+                      remat: bool = True) -> jax.Array:
     """Blockwise attention over q/k/v (B, S, H, D) processing q in chunks of
-    ``chunk_size`` against the (optionally host-offloaded) full KV, with
+    ``chunk_size`` against the (optionally host-resident) chunked KV, with
     online-softmax accumulation.  Device working set per step: one q chunk ×
-    the streamed kv chunk — O(chunk²) score tiles, never O(S²)."""
+    the streamed kv chunk — O(chunk²) score tiles, never O(S²).  GQA-aware
+    (KV heads dividing H)."""
     B, S, H, D = q.shape
+    KV = k.shape[2]
+    if H % KV != 0:
+        raise ValueError(f"heads {H} not a multiple of kv heads {KV}")
     if S % chunk_size != 0:
         raise ValueError(f"S={S} not divisible by chunk_size={chunk_size}")
     n = S // chunk_size
     scale = 1.0 / math.sqrt(D)
-
-    if offload_kv and not isinstance(k, jax.core.Tracer):
-        # only committed arrays can be re-placed; under jit tracing the
-        # placement belongs to the enclosing program (use the engine's
-        # activation-checkpointing host-offload policy there instead)
-        try:
-            host = _host_sharding(k)
-            if host is not None:
-                k = jax.device_put(k, host)
-                v = jax.device_put(v, host)
-        except Exception:
-            pass  # backends without pinned_host: run with device-resident KV
+    group = H // KV
 
     qc = q.reshape(B, n, chunk_size, H, D).swapaxes(0, 1)  # (n, B, c, H, D)
-    kc = k.reshape(B, n, chunk_size, H, D).swapaxes(0, 1)
-    vc = v.reshape(B, n, chunk_size, H, D).swapaxes(0, 1)
+    kc = k.reshape(B, n, chunk_size, KV, D).swapaxes(0, 1)
+    vc = v.reshape(B, n, chunk_size, KV, D).swapaxes(0, 1)
+    # host placement needs a sharding that matches the program's layout: a
+    # NamedSharding when a mesh/spec is given, else single-device ONLY on a
+    # single-device program (a bare SingleDeviceSharding inside a dp/fsdp-
+    # sharded jit would gather all KV onto device 0)
+    offload = offload_kv and _host_capable() and (
+        mesh is not None or jax.device_count() == 1)
+    elem_spec = None
+    if offload:
+        # chunk stacks live on the host AT KV HEADS (GQA un-expanded, so
+        # host memory and the per-chunk DMA carry only unique KV); the scan
+        # body device_puts one chunk back per step and the scheduler
+        # overlaps chunk j+1's copy with chunk j's compute (the reference's
+        # double-buffered offloading streams)
+        kc = _put(kc, "pinned_host", kv_spec, mesh)
+        vc = _put(vc, "pinned_host", kv_spec, mesh)
+        elem_spec = (P(*kv_spec[1:]) if kv_spec is not None else None)
 
     def q_step(_, qi_and_idx):
         qi, iq = qi_and_idx  # (B, c, H, D)
 
         def kv_step(carry, kj_and_idx):
             kj, vj, jk = kj_and_idx
+            if offload:
+                kj = _put(kj, "device", elem_spec, mesh)
+                vj = _put(vj, "device", elem_spec, mesh)
+            if group != 1:  # expand GQA on device, post-DMA
+                kj = jnp.repeat(kj, group, axis=2)
+                vj = jnp.repeat(vj, group, axis=2)
 
             def compute(carry):
                 acc, m, l = carry
@@ -110,6 +155,11 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         out = acc / l_safe.transpose(0, 2, 1)[..., None]
         return None, out.astype(q.dtype)
 
+    if remat:
+        # backward re-streams KV from host and recomputes the chunk instead
+        # of storing per-chunk probability tiles (reference: recomputation
+        # inside _FPDTGPUOffloadingAttentionImpl_ backward)
+        q_step = jax.checkpoint(q_step, prevent_cse=False)
     _, out = lax.scan(q_step, None, (qc, jnp.arange(n)))
     return out.swapaxes(0, 1).reshape(B, S, H, D)
 
@@ -124,11 +174,54 @@ def _largest_divisor_leq(n: int, cap: int) -> int:
 def fpdt_attention(chunk_size: int = 2048, offload_kv: bool = True):
     """AttentionFn factory for TransformerConfig injection.  The effective
     chunk is the largest divisor of S not exceeding ``chunk_size`` so any
-    sequence length works."""
+    sequence length works.  With a live ``sp`` mesh axis the call composes
+    sequence parallelism via GSPMD resharding (``fpdt_ulysses_attention``)."""
 
     def attn(q, k, v, causal=True):
+        topo = get_topology() if topology_initialized() else None
+        if topo is not None and topo.size("sp") > 1:
+            return _fpdt_sp(q, k, v, causal, chunk_size, offload_kv, topo)
         chunk = _largest_divisor_leq(q.shape[1], chunk_size)
         return chunked_attention(q, k, v, chunk_size=chunk,
                                  causal=causal, offload_kv=offload_kv)
 
     return attn
+
+
+def fpdt_ulysses_attention(chunk_size: int = 2048, offload_kv: bool = True):
+    """Explicit sp-composed factory (reference: FPDT layered over Ulysses)."""
+    return fpdt_attention(chunk_size=chunk_size, offload_kv=offload_kv)
+
+
+def _fpdt_sp(q, k, v, causal, chunk_size, offload_kv, topo):
+    """Sequence-parallel FPDT: seq-sharded → head-sharded resharding (XLA
+    derives the all-to-all), chunked host-streamed attention on the global
+    view, reshard back.  One compiled program: the a2a, the host DMAs and
+    the blockwise compute all schedule together."""
+    mesh = topo.mesh
+    sp = topo.size("sp")
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    if H % sp != 0:
+        raise ValueError(f"fpdt sp requires heads({H}) % sp({sp}) == 0")
+    if KV % sp != 0:
+        from .ulysses import min_kv_replication
+
+        rep = min_kv_replication(H, KV, sp)
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    batch = ("dp", "fsdp")
+    head = NamedSharding(mesh, P(batch, None, "sp", None))
+    seq = NamedSharding(mesh, P(batch, "sp", None, None))
+    qh = lax.with_sharding_constraint(q, head)
+    kh = lax.with_sharding_constraint(k, head)
+    vh = lax.with_sharding_constraint(v, head)
+    chunk = _largest_divisor_leq(S, chunk_size)
+    # host KV stacks shard over sp (the kv-heads dim, matching the compute
+    # sharding); the batch dim stays unsharded in host memory — batch sizes
+    # need not divide dp at this API level
+    o = chunked_attention(qh, kh, vh, chunk_size=chunk, causal=causal,
+                          offload_kv=offload_kv,
+                          kv_spec=P(None, None, None, "sp", None),
+                          mesh=mesh)
+    return lax.with_sharding_constraint(o, seq)

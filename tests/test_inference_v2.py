@@ -235,3 +235,25 @@ def test_burst_sampling(devices, tiny_model):
         out.append(toks)
     assert out[0] == out[1]  # same seed reproducible
     assert out[0] != out[2]  # different seed differs
+
+
+def test_soa_fast_path_engages(devices, tiny_model):
+    """Steady-state decode must run through the vectorized SoA path, and
+    its results must match the descriptor path's token-exact output."""
+    cfg, params = tiny_model
+
+    def _engine():
+        return InferenceEngineV2(cfg, params, V2Config(
+            max_tokens_per_step=32, max_seqs=4, block_size=8, num_blocks=64,
+            max_blocks_per_seq=8, dtype="float32"))
+
+    e1 = _engine()
+    e2 = _engine()
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+    for p in prompts:
+        e1.put(p, max_new_tokens=12)
+        e2.put(p, max_new_tokens=12)
+    r1 = e1.generate_all(burst=1)   # single-step (fast path per token)
+    r2 = e2.generate_all(burst=4)   # burst path over the same table
+    assert e1.fast_steps > 0, "SoA decode path never engaged"
+    assert r1 == r2
