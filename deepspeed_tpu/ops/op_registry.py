@@ -107,6 +107,22 @@ def _ensure_builtin_ops() -> None:
 
         return paged_attention
 
+    def _evoformer():
+        from . import evoformer
+
+        return evoformer
+
+    def _grouped_gemm():
+        from .pallas import grouped_matmul
+
+        return grouped_matmul
+
+    register_op("evoformer_attn", _evoformer,
+                description="DS4Science evoformer attention (pair/mask bias)",
+                module="deepspeed_tpu.ops.evoformer")
+    register_op("grouped_gemm", _grouped_gemm,
+                description="Pallas grouped GEMM (dropless MoE expert FFN)",
+                module="deepspeed_tpu.ops.pallas.grouped_matmul")
     register_op("flash_attention", _flash, description="Pallas fused attention (fwd/bwd)",
                 module="deepspeed_tpu.ops.pallas.flash_attention")
     register_op("fused_adam", _fused_adam, description="fused Adam/AdamW/Lion/LAMB updates",

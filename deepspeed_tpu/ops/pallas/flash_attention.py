@@ -46,6 +46,19 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def aligned_divisor(n: int, cap: int, align: int = NUM_SUBLANES):
+    """Largest divisor of ``n`` ≤ ``cap`` that is a multiple of ``align``;
+    ``n`` itself when ``n ≤ cap`` (a full-dim block is always legal — Mosaic
+    pads it). None when no aligned divisor exists (caller should fall back).
+    """
+    if n <= cap:
+        return n
+    for d in range(cap - cap % align, align - 1, -align):
+        if n % d == 0:
+            return d
+    return None
+
+
 def _band_mask(s_shape, q_start, k_start, causal: bool, window: int):
     """Causal/sliding-window keep-mask for one (bq, bk) tile.  ``window > 0``
     keeps keys in (query-window, query] — the band implies the causal upper
@@ -82,19 +95,33 @@ def _seg_mask(q_seg_tile, k_seg_tile, block_k: int):
     return jnp.equal(qs, k_seg_tile)
 
 
-def _unpack(refs, has_mask: bool, has_seg: bool, n_io: int):
-    """Split the kernel's positional refs into (mask_tab, q_seg, k_seg, io)."""
+def _unpack(refs, has_mask: bool, has_seg: bool, n_io: int,
+            has_b1: bool = False, has_b2: bool = False):
+    """Split the kernel's positional refs into
+    (mask_tab, q_seg, k_seg, b1, b2, io).
+
+    The additive biases b1/b2 are FORWARD-ONLY: ``_flash_attention_bhsd``'s
+    custom VJP never threads them, and the backward kernels must not accept
+    them — recomputing p = exp(s - lse) with a bias-less s against a biased
+    lse would be silently wrong. The bias backward lives in
+    ``ops/evoformer.py`` (its own VJP, recompute scan)."""
     idx = 0
-    mask_tab = q_seg = k_seg = None
+    mask_tab = q_seg = k_seg = b1 = b2 = None
     if has_mask:
         mask_tab = refs[0]
         idx = 1
     if has_seg:
         q_seg, k_seg = refs[idx], refs[idx + 1]
         idx += 2
+    if has_b1:
+        b1 = refs[idx]
+        idx += 1
+    if has_b2:
+        b2 = refs[idx]
+        idx += 1
     io = refs[idx:]
     assert len(io) == n_io, (len(io), n_io, has_mask, has_seg)
-    return mask_tab, q_seg, k_seg, io
+    return mask_tab, q_seg, k_seg, b1, b2, io
 
 
 def _masked_scores(q_ref, k_ref, q_seg_ref, k_seg_ref, q_start, k_start,
@@ -124,8 +151,10 @@ def _masked_scores(q_ref, k_ref, q_seg_ref, k_seg_ref, q_start, k_start,
 
 
 def _fwd_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
-                block_k: int, window: int, has_mask: bool, has_seg: bool):
-    mask_tab, q_seg_ref, k_seg_ref, io = _unpack(refs, has_mask, has_seg, 8)
+                block_k: int, window: int, has_mask: bool, has_seg: bool,
+                has_b1: bool = False, has_b2: bool = False):
+    mask_tab, q_seg_ref, k_seg_ref, b1_ref, b2_ref, io = _unpack(
+        refs, has_mask, has_seg, 8, has_b1, has_b2)
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = io
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
@@ -150,6 +179,14 @@ def _fwd_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
         s, keep = _masked_scores(q_ref, k_ref, q_seg_ref, k_seg_ref, q_start,
                                  k_start, sm_scale, causal, window, block_k,
                                  has_seg)  # (bq, bk)
+        # additive attention biases (evoformer pair/mask biases): a per-key
+        # row bias broadcast over queries and a full (bq, bk) tile
+        if has_b1:
+            s = s + b1_ref[0, :1].astype(jnp.float32)  # (1, bk) → rows
+        if has_b2:
+            s = s + b2_ref[0, 0].astype(jnp.float32)  # (bq, bk)
+        if (has_b1 or has_b2) and keep is not None:
+            s = jnp.where(keep, s, DEFAULT_MASK_VALUE)
 
         m_prev = m_ref[:]  # (bq, 1)
         l_prev = l_ref[:]
@@ -196,7 +233,8 @@ def _pallas_call(kernel, grid, in_specs, out_specs, out_shape, scratch_shapes,
 
 
 def _flash_fwd(q, k, v, q_seg, k_seg, mask_tab, sm_scale, causal, block_q,
-               block_k, window=0) -> Tuple[jax.Array, jax.Array]:
+               block_k, window=0, bias_kv=None,
+               bias_qk=None) -> Tuple[jax.Array, jax.Array]:
     B, H, S, D = q.shape
     KV = k.shape[1]
     Skv = k.shape[2]
@@ -204,6 +242,8 @@ def _flash_fwd(q, k, v, q_seg, k_seg, mask_tab, sm_scale, causal, block_q,
     nk = pl.cdiv(Skv, block_k)
     group = H // KV
     has_seg = q_seg is not None
+    has_b1 = bias_kv is not None
+    has_b2 = bias_qk is not None
 
     grid = (B, H, nq, nk)
     in_specs = []
@@ -216,6 +256,16 @@ def _flash_fwd(q, k, v, q_seg, k_seg, mask_tab, sm_scale, causal, block_q,
                          lambda b, h, iq, ik, *_: (b, 0, ik)),
         ]
         inputs += [q_seg, k_seg]
+    if has_b1:  # per-key bias, (B, NUM_SUBLANES, Skv) lane layout
+        in_specs += [pl.BlockSpec((1, NUM_SUBLANES, block_k),
+                                  lambda b, h, iq, ik, *_: (b, 0, ik))]
+        inputs += [bias_kv]
+    if has_b2:  # full (q, k) bias, batch-broadcast (e.g. pair bias over MSA)
+        b2_rep = B // bias_qk.shape[0]
+        in_specs += [pl.BlockSpec(
+            (1, 1, block_q, block_k),
+            lambda b, h, iq, ik, *_: (b // b2_rep, h, iq, ik))]
+        inputs += [bias_qk]
     in_specs += [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
         pl.BlockSpec((1, 1, block_k, D),
@@ -227,7 +277,8 @@ def _flash_fwd(q, k, v, q_seg, k_seg, mask_tab, sm_scale, causal, block_q,
     out, lse = _pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, window=window,
-                          has_mask=mask_tab is not None, has_seg=has_seg),
+                          has_mask=mask_tab is not None, has_seg=has_seg,
+                          has_b1=has_b1, has_b2=has_b2),
         grid, in_specs,
         [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
@@ -256,7 +307,8 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, nq: int,
     # grid: (B, KV, nk, group*nq) — the innermost dim walks every q block of
     # every query head in this kv head's group, accumulating straight into
     # the per-KV-head dk/dv (no (B, H, S, D) f32 intermediate).
-    mask_tab, q_seg_ref, k_seg_ref, io = _unpack(refs, has_mask, has_seg, 10)
+    mask_tab, q_seg_ref, k_seg_ref, _, _, io = _unpack(
+        refs, has_mask, has_seg, 10)
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
      dk_ref, dv_ref, dk_acc, dv_acc) = io
     ik, iqg = pl.program_id(2), pl.program_id(3)
@@ -306,7 +358,8 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, nq: int,
 
 def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, window: int,
                    has_mask: bool, has_seg: bool):
-    mask_tab, q_seg_ref, k_seg_ref, io = _unpack(refs, has_mask, has_seg, 8)
+    mask_tab, q_seg_ref, k_seg_ref, _, _, io = _unpack(
+        refs, has_mask, has_seg, 8)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = io
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
@@ -501,15 +554,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # the band width (never raise it above the caller's request)
         if 0 < window < cap:
             cap = min(cap, max(128, window // 128 * 128))
-        if n <= cap:
-            return n
-        # largest sublane-aligned divisor of n not exceeding cap, so raising
-        # the default can never push a previously-fused shape onto the O(S²)
-        # fallback (e.g. S=1536: divisor 768, not min()=1024 → unusable)
-        for d in range(cap, 7, -1):
-            if n % d == 0 and d % 8 == 0:
-                return d
-        return cap  # no aligned divisor; the usable-gate will fall back
+        # largest sublane-aligned divisor, so raising the default can never
+        # push a previously-fused shape onto the O(S²) fallback (e.g.
+        # S=1536: divisor 768, not min()=1024 → unusable); when none exists
+        # return cap and let the usable-gate fall back
+        return aligned_divisor(n, cap) or cap
 
     if block_mask is None:
         # block sizes are free parameters without a mask table; with one,
