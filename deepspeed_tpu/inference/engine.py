@@ -32,6 +32,10 @@ class InferenceConfig:
     max_seq_len: int = 2048
     max_batch_size: int = 8
     dtype: str = "bfloat16"
+    # weight-only quantization (W8A16 / W4A16 via the Pallas mixed GEMM);
+    # reference: deepspeed/inference/quantization group-wise weight quant
+    quantize_bits: int = 0
+    quantize_group: int = 256
 
 
 def _kv_cache_init(cfg: tfm.TransformerConfig, batch: int, max_len: int, dtype):
@@ -149,6 +153,14 @@ class InferenceEngine:
                                       tfm.param_axes(self.model_config,
                                                      params=params),
                                       rules, self.topo)
+        if icfg.quantize_bits:
+            # quantize on host FIRST: the chip never holds the fp weights
+            # (a model that only fits quantized must not OOM during init)
+            from .quantization import quantize_on_host, shardings_for_quantized
+
+            params = quantize_on_host(params, icfg.quantize_bits,
+                                      icfg.quantize_group)
+            shardings = shardings_for_quantized(params, shardings)
         self.params = jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s),
                                    params, shardings)
 

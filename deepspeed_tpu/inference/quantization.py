@@ -1,0 +1,114 @@
+"""Weight-only quantization for inference params.
+
+Reference: ``deepspeed/inference/quantization`` (``_init_group_wise_weight_
+quantization``, matmul_4bit/8bit paths) — weights live in HBM as int8/int4
+and dequantize inside the GEMM. Here the projection weights of every
+transformer layer become ``QuantizedWeight`` pytree nodes that
+``models/transformer._lin`` routes through the Pallas mixed GEMM; stacked
+(L, K, N) layers slice transparently under the layer scan.
+
+Embeddings / lm_head / norms stay high-precision (gather and tiny tensors
+gain nothing from int codes), matching the reference's exclude list.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+from ..ops.pallas.mixed_gemm import QuantizedWeight, quantize_gemm_weight
+from ..utils.logging import logger
+
+# projection weights inside each layer's attn/mlp dicts
+_QUANT_KEYS = frozenset({"wq", "wk", "wv", "wo", "w_in", "w_out", "w_gate"})
+_QUANT_PARENTS = frozenset({"attn", "mlp"})
+
+
+def quantize_model_params(params: Dict[str, Any], bits: int = 8,
+                          group: int = 256) -> Dict[str, Any]:
+    """Replace layer projection weights with QuantizedWeight nodes."""
+    saw_moe = False
+
+    def walk(tree, parent=None):
+        nonlocal saw_moe
+        if isinstance(tree, dict):
+            if "moe" in tree:
+                saw_moe = True
+            return {k: (quantize_gemm_weight(v, bits=bits, group=group)
+                        if (parent in _QUANT_PARENTS and k in _QUANT_KEYS
+                            and getattr(v, "ndim", 0) >= 2)
+                        else walk(v, k))
+                    for k, v in tree.items()}
+        return tree
+
+    out = walk(params)
+    if saw_moe:
+        logger.warning(
+            "quantize_model_params: expert (MoE) weights stay "
+            "high-precision — the einsum dispatch path does not take "
+            "QuantizedWeight; only attention/MLP projections were quantized. "
+            "Check quantized_bytes() for the actual savings.")
+    return out
+
+
+def shardings_for_quantized(params: Dict[str, Any],
+                            shardings: Dict[str, Any]) -> Dict[str, Any]:
+    """Mirror a full-weight sharding tree onto a quantized param tree.
+
+    Codes keep the original weight's PartitionSpec where divisibility still
+    holds (int4 halves K); scales drop any axis that no longer divides.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def mesh_div(ns, dim_size, spec_entry):
+        if spec_entry is None:
+            return True
+        names = (spec_entry,) if isinstance(spec_entry, str) else spec_entry
+        n = 1
+        for name in names:
+            n *= ns.mesh.shape[name]
+        return dim_size % n == 0
+
+    def adapt(ns, arr):
+        spec = list(ns.spec) + [None] * (arr.ndim - len(ns.spec))
+        spec = [s if mesh_div(ns, d, s) else None
+                for s, d in zip(spec[:arr.ndim], arr.shape)]
+        return NamedSharding(ns.mesh, PartitionSpec(*spec))
+
+    def walk(p, s):
+        if isinstance(p, QuantizedWeight):
+            return QuantizedWeight(adapt(s, p.codes), adapt(s, p.scales),
+                                   p.bits, p.group, p.k)
+        if isinstance(p, dict):
+            return {k: walk(v, s[k]) for k, v in p.items()}
+        return s
+
+    return walk(params, shardings)
+
+
+def quantize_on_host(params: Dict[str, Any], bits: int,
+                     group: int) -> Dict[str, Any]:
+    """Quantize on the host CPU backend so the accelerator never holds the
+    full-precision weights (the whole point of weight-only quantization)."""
+    try:
+        cpus = jax.local_devices(backend="cpu")
+    except RuntimeError:  # platform-restricted build: quantize in place
+        return quantize_model_params(params, bits=bits, group=group)
+    with jax.default_device(cpus[0]):
+        host = jax.tree.map(lambda x: jax.numpy.asarray(x), params)
+        return quantize_model_params(host, bits=bits, group=group)
+
+
+def quantized_bytes(params: Dict[str, Any]) -> Dict[str, int]:
+    """{quantized, total} parameter bytes — the memory-saving accounting."""
+    q = t = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedWeight)):
+        if isinstance(leaf, QuantizedWeight):
+            b = leaf.codes.nbytes + leaf.scales.nbytes
+            q += b
+            t += b
+        else:
+            t += getattr(leaf, "nbytes", 0)
+    return {"quantized": q, "total": t}

@@ -105,9 +105,14 @@ def transformer_block(x: jax.Array, params: Dict[str, Any],
                                     params["attn2"], cfg.heads,
                                     context=context)
     y = _layer_norm(h, params["norm3"], cfg.eps)
-    ff = _linear(y, params["ff1"])
-    # GEGLU, diffusers convention: value half first, gelu on the SECOND half
-    val, gate = jnp.split(ff, 2, axis=-1)
+    # GEGLU, diffusers convention: value half first, gelu on the SECOND half.
+    # Sharded params pre-split ff1 into val/gate kernels so the elementwise
+    # product stays device-local under tensor parallelism.
+    if "ff1_val" in params:
+        val = _linear(y, params["ff1_val"])
+        gate = _linear(y, params["ff1_gate"])
+    else:
+        val, gate = jnp.split(_linear(y, params["ff1"]), 2, axis=-1)
     y = val * jax.nn.gelu(gate, approximate=True)
     return h + _linear(y, params["ff2"])
 
@@ -172,6 +177,26 @@ def shard_block_params(params: Dict[str, Any], mesh,
     row = NamedSharding(mesh, P(axis, None))
     colb = NamedSharding(mesh, P(axis))
     rep = NamedSharding(mesh, P())
+
+    def split_geglu(tree):
+        # pre-split ff1 into val/gate halves: column-sharding the fused
+        # (C, 2F) kernel would land val and gate on disjoint devices and
+        # force a reshard before every val·gelu(gate)
+        if isinstance(tree, dict):
+            if "ff1" in tree:
+                tree = dict(tree)
+                ff1 = tree.pop("ff1")
+                vk, gk = jnp.split(ff1["kernel"], 2, axis=-1)
+                tree["ff1_val"] = {"kernel": vk}
+                tree["ff1_gate"] = {"kernel": gk}
+                if "bias" in ff1:
+                    vb, gb = jnp.split(ff1["bias"], 2, axis=-1)
+                    tree["ff1_val"]["bias"] = vb
+                    tree["ff1_gate"]["bias"] = gb
+            return {k: split_geglu(v) for k, v in tree.items()}
+        return tree
+
+    params = split_geglu(params)
 
     def place(path, leaf):
         name = "/".join(str(k.key) for k in path

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.pallas.mixed_gemm import QuantizedWeight, mixed_gemm
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -360,7 +362,11 @@ def resolve_attention(impl: str) -> AttentionFn:
 
 
 def _lin(x, p, w_key, b_key):
-    y = x @ p[w_key].astype(x.dtype)
+    w = p[w_key]
+    if isinstance(w, QuantizedWeight):  # W8A16/W4A16 in-kernel dequant
+        y = mixed_gemm(x, w)
+    else:
+        y = x @ w.astype(x.dtype)
     if b_key in p:
         y = y + p[b_key].astype(x.dtype)
     return y
