@@ -5,16 +5,29 @@ Capability analogue of the reference's ``autotuning/autotuner.py``
 over (zero stage, micro batch size, remat policy) measuring real training
 throughput and return the best config.
 
-TPU-native simplification: experiments run in-process (no launcher round
-trips) — each candidate builds an engine, times a few steps, and is torn
-down; compile cache makes repeated shapes cheap.  OOMs and invalid configs
-are recorded as failures, mirroring the reference's fault-tolerant sweep.
+Two execution modes:
+
+* **in-process** (``Autotuner``): each candidate builds an engine, times a
+  few steps, and is torn down; compile cache makes repeated shapes cheap.
+* **subprocess** (``SubprocessAutotuner`` + ``ExperimentScheduler``): each
+  candidate is a fresh ``experiment_runner`` process — matching the
+  reference's scheduler/launcher round trips (``autotuning/scheduler.py:
+  23,144``) — so chip OOMs or compile wedges cannot poison the sweep, and
+  candidates can be dispatched to other hosts through the ``dstpu``
+  launcher (``launcher_args``).
+
+OOMs and invalid configs are recorded as failures in both modes, mirroring
+the reference's fault-tolerant sweep.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import itertools
+import json
+import os
+import subprocess
+import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -133,3 +146,113 @@ class Autotuner:
         log_dist(f"autotune best: {best.config_overrides} "
                  f"({best.throughput:.1f} samples/s)")
         return best.config_overrides, self.experiments
+
+
+# ---------------------------------------------------------------------------
+# subprocess mode (reference scheduler.py equivalent)
+# ---------------------------------------------------------------------------
+
+
+def apply_overrides(config: Dict[str, Any],
+                    overrides: Dict[str, Any]) -> Dict[str, Any]:
+    """Map sweep-axis names onto engine-config keys (dotted paths pass
+    through, e.g. ``"zero_optimization.stage"``)."""
+    import copy
+
+    out = copy.deepcopy(config)
+    alias = {"zero_stage": "zero_optimization.stage",
+             "micro_batch": "train_micro_batch_size_per_gpu"}
+    for key, value in overrides.items():
+        path = alias.get(key, key).split(".")
+        node = out
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = value
+    return out
+
+
+class ExperimentScheduler:
+    """Run experiment specs as subprocesses (one at a time — a chip runs one
+    XLA client; cross-host placement belongs to ``launcher_args``) and
+    collect their JSON results (the reference ResourceManager's job)."""
+
+    def __init__(self, exps_dir: str, launcher_args: Sequence[str] = (),
+                 env: Optional[Dict[str, str]] = None,
+                 timeout_s: float = 900):
+        self.exps_dir = exps_dir
+        self.launcher_args = list(launcher_args)
+        self.env = env
+        self.timeout_s = timeout_s
+        os.makedirs(exps_dir, exist_ok=True)
+
+    def command(self, spec_path: str, result_path: str) -> List[str]:
+        return [*self.launcher_args, sys.executable, "-m",
+                "deepspeed_tpu.autotuning.experiment_runner",
+                "--spec", spec_path, "--result", result_path]
+
+    def run_one(self, spec: Dict[str, Any], tag: str) -> Dict[str, Any]:
+        spec_path = os.path.join(self.exps_dir, f"{tag}.json")
+        result_path = os.path.join(self.exps_dir, f"{tag}.result.json")
+        if os.path.exists(result_path):  # never read a previous sweep's file
+            os.unlink(result_path)
+        with open(spec_path, "w") as f:
+            json.dump(spec, f)
+        env = dict(os.environ, **(self.env or {}))
+        try:
+            proc = subprocess.run(self.command(spec_path, result_path),
+                                  env=env, timeout=self.timeout_s,
+                                  capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            return {"ok": False, "error": f"timeout after {self.timeout_s}s"}
+        if not os.path.exists(result_path):
+            # the runner died before its except-handler could report (hard
+            # abort, segfault, bad launcher args) — surface the stderr tail
+            tail = (proc.stderr or "").strip().splitlines()[-8:]
+            return {"ok": False,
+                    "error": f"runner exited rc={proc.returncode} with no "
+                             f"result file; stderr tail: {' | '.join(tail)}"}
+        with open(result_path) as f:
+            return json.load(f)
+
+
+class SubprocessAutotuner(Autotuner):
+    """Autotuner whose measurements run in fresh processes.
+
+    ``model``: JSON-able model description for the runner
+    ({"preset": ..., "overrides": {...}}); ``base_config``: the engine
+    config every candidate starts from.
+    """
+
+    def __init__(self, cfg: AutotuningConfig, model: Dict[str, Any],
+                 base_config: Dict[str, Any],
+                 space: Optional[Dict[str, Sequence]] = None,
+                 scheduler: Optional[ExperimentScheduler] = None,
+                 profile_steps: int = 3, seq_len: Optional[int] = None):
+        super().__init__(cfg, make_engine=None, make_batch=None, space=space)
+        self.model = model
+        self.base_config = base_config
+        self.scheduler = scheduler or ExperimentScheduler(cfg.exps_dir)
+        self.profile_steps = profile_steps
+        self.seq_len = seq_len
+        self._counter = 0
+
+    def _measure(self, overrides: Dict[str, Any]) -> Experiment:
+        exp = Experiment(config_overrides=dict(overrides))
+        spec = {
+            "model": self.model,
+            "config": apply_overrides(self.base_config, overrides),
+            "warmup_steps": max(1, self.cfg.start_profile_step - 1),
+            "profile_steps": self.profile_steps,
+        }
+        if self.seq_len:
+            spec["seq_len"] = self.seq_len
+        self._counter += 1
+        result = self.scheduler.run_one(spec, tag=f"exp_{self._counter:03d}")
+        if result.get("ok"):
+            exp.step_time_s = result["step_time_s"]
+            exp.throughput = result["throughput"]
+        else:
+            exp.error = result.get("error", "unknown failure")
+            logger.warning(f"autotune candidate {overrides} failed: "
+                           f"{exp.error}")
+        return exp

@@ -1,0 +1,94 @@
+"""Subprocess experiment runner for the autotuner.
+
+Reference: ``autotuning/scheduler.py`` (``ResourceManager:23``/
+``run_experiment:144``) — each candidate config runs as a fresh launcher
+job whose results are read back from files. Here each candidate is one
+``python -m deepspeed_tpu.autotuning.experiment_runner`` process: a fresh
+process means a fresh XLA client, so a candidate that OOMs the chip or
+wedges compilation cannot poison the sweep, and multi-host candidates can
+be dispatched through the ``dstpu`` launcher unchanged.
+
+The experiment spec is JSON (model preset + config overrides), not a Python
+closure — the contract that makes cross-process/cross-host dispatch
+possible.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+import traceback
+from typing import Any, Dict
+
+
+def run_experiment(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Build an engine from the JSON spec, time a few steps, return metrics."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import transformer as tfm
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    model = spec.get("model", {})
+    cfg = tfm.get_config(model.get("preset", "tiny"),
+                         **model.get("overrides", {}))
+    params = tfm.init_params(jax.random.PRNGKey(model.get("seed", 0)), cfg)
+
+    def loss_fn(p, batch, rng):
+        return tfm.loss_fn(p, batch, cfg)
+
+    mspec = ModelSpec(loss_fn=loss_fn, params=params,
+                      param_axes=tfm.param_axes(cfg))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=mspec,
+                                               config=spec["config"])
+    seq = int(spec.get("seq_len", min(cfg.max_seq_len, 512)))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(engine.train_batch_size, seq)).astype(np.int32)}
+
+    warmup = int(spec.get("warmup_steps", 2))
+    steps = int(spec.get("profile_steps", 3))
+    for _ in range(warmup):
+        engine.train_batch(batch)
+    engine.accelerator.synchronize()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_batch(batch)
+    engine.accelerator.synchronize()
+    dt = (time.perf_counter() - t0) / steps
+    tokens_per_s = engine.train_batch_size * seq / dt
+    return {"ok": True, "step_time_s": dt,
+            "throughput": engine.train_batch_size / dt,
+            "tokens_per_s": tokens_per_s}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spec", required=True, help="path to experiment JSON")
+    ap.add_argument("--result", required=True, help="where to write metrics")
+    args = ap.parse_args()
+    import os
+
+    plat = os.environ.get("DSTPU_PLATFORM")
+    if plat:  # test harnesses force CPU; must land before first device query
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    with open(args.spec) as f:
+        spec = json.load(f)
+    try:
+        result = run_experiment(spec)
+        rc = 0
+    except Exception as e:  # failures are sweep data, not crashes
+        result = {"ok": False, "error": f"{type(e).__name__}: {e}",
+                  "traceback": traceback.format_exc()}
+        rc = 1
+    with open(args.result, "w") as f:
+        json.dump(result, f)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

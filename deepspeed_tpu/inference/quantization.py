@@ -54,32 +54,36 @@ def quantize_model_params(params: Dict[str, Any], bits: int = 8,
 
 def shardings_for_quantized(params: Dict[str, Any],
                             shardings: Dict[str, Any]) -> Dict[str, Any]:
-    """Mirror a full-weight sharding tree onto a quantized param tree.
+    """Mirror a sharding tree onto a quantized param tree.
 
-    Codes keep the original weight's PartitionSpec where divisibility still
-    holds (int4 halves K); scales drop any axis that no longer divides.
+    Quantized leaves are placed REPLICATED: GSPMD cannot partition the
+    opaque ``mixed_gemm`` pallas_call, so tensor-sharded codes would be
+    all-gathered before every projection — strictly worse than storing them
+    replicated (they are already 2–4× smaller than the weights they
+    replace). Partitioning the kernel itself (shard_map / custom
+    partitioning over the N axis) is the follow-up that restores per-device
+    memory scaling; until then, warn when TP > 1 so the user knows the
+    quantized bytes are per-device, not per-mesh.
     """
     from jax.sharding import NamedSharding, PartitionSpec
 
-    def mesh_div(ns, dim_size, spec_entry):
-        if spec_entry is None:
-            return True
-        names = (spec_entry,) if isinstance(spec_entry, str) else spec_entry
-        n = 1
-        for name in names:
-            n *= ns.mesh.shape[name]
-        return dim_size % n == 0
-
-    def adapt(ns, arr):
-        spec = list(ns.spec) + [None] * (arr.ndim - len(ns.spec))
-        spec = [s if mesh_div(ns, d, s) else None
-                for s, d in zip(spec[:arr.ndim], arr.shape)]
-        return NamedSharding(ns.mesh, PartitionSpec(*spec))
+    warned = False
 
     def walk(p, s):
+        nonlocal warned
         if isinstance(p, QuantizedWeight):
-            return QuantizedWeight(adapt(s, p.codes), adapt(s, p.scales),
-                                   p.bits, p.group, p.k)
+            ns = s
+            if not warned and any(ns.mesh.shape[a] > 1 for e in ns.spec
+                                  if e is not None
+                                  for a in ((e,) if isinstance(e, str) else e)):
+                logger.warning(
+                    "quantized weights are stored replicated across the "
+                    "tensor-parallel mesh (the mixed GEMM kernel is not yet "
+                    "partitioned); per-device weight memory is the full "
+                    "quantized model")
+                warned = True
+            rep = NamedSharding(ns.mesh, PartitionSpec())
+            return QuantizedWeight(rep, rep, p.bits, p.group, p.k)
         if isinstance(p, dict):
             return {k: walk(v, s[k]) for k, v in p.items()}
         return s

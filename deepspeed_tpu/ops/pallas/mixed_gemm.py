@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..quantizer import pack_int4, unpack_int4
 from .flash_attention import _interpret, aligned_divisor
 
 
@@ -86,15 +87,12 @@ def quantize_gemm_weight(w: jax.Array, bits: int = 8,
         if K % 2:  # pad a zero K-row so two codes always pack per byte
             pad = [(0, 0)] * len(lead) + [(0, 1), (0, 0)]
             codes = jnp.pad(codes, pad)
-        lo = codes[..., 0::2, :] & 0xF
-        hi = (codes[..., 1::2, :] & 0xF) << 4
-        codes = (lo | hi).astype(jnp.int8)
+        codes = pack_int4(codes[..., 0::2, :], codes[..., 1::2, :])
     return QuantizedWeight(codes, scale[..., 0, :], bits, group, k=K)
 
 
 def _unpack_int4(c):
-    lo = (c << 4).astype(jnp.int8) >> 4  # sign-extend low nibble → row 2r
-    hi = c >> 4  # arithmetic shift → row 2r+1
+    lo, hi = unpack_int4(c)  # byte row r holds K-rows 2r (lo), 2r+1 (hi)
     tk2, tn = c.shape
     return jnp.stack([lo, hi], axis=1).reshape(tk2 * 2, tn)
 
@@ -149,8 +147,7 @@ def _gemm_pallas(x2: jax.Array, qw: QuantizedWeight, tm: int, tn: int):
 def dequantize_gemm_weight(qw: QuantizedWeight) -> jax.Array:
     codes = qw.codes
     if qw.bits == 4:
-        lo = (codes << 4).astype(jnp.int8) >> 4
-        hi = codes >> 4
+        lo, hi = unpack_int4(codes)
         # interleave: byte row r holds K-rows 2r (lo nibble), 2r+1 (hi)
         codes = jnp.stack([lo, hi], axis=-2).reshape(
             *qw.codes.shape[:-2], 2 * qw.codes.shape[-2], qw.out_features)
@@ -175,13 +172,20 @@ def mixed_gemm(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     for d in lead:
         M *= d
     x2 = x.reshape(M, K)
-    tm = aligned_divisor(M, 256)
+    # ragged M (e.g. prefill with an odd token count) pads up to the sublane
+    # multiple so the kernel path — the whole bandwidth win — is never lost
+    # to an unlucky batch·seq product
+    pad_m = (-M) % 8
+    tm = aligned_divisor(M + pad_m, 256)
     tn = aligned_divisor(N, 256, 128)
     usable = (tm is not None and tn is not None and K % qw.group == 0
               and qw.group % 2 == 0
               and (qw.group % 128 == 0 or qw.group == K))
     if usable:
-        out = _gemm_pallas(x2, qw, tm, tn)
+        xp = jnp.pad(x2, ((0, pad_m), (0, 0))) if pad_m else x2
+        out = _gemm_pallas(xp, qw, tm, tn)
+        if pad_m:
+            out = out[:M]
     else:
         out = x2 @ dequantize_gemm_weight(qw).astype(x2.dtype)
     return out.reshape(*lead, N)

@@ -23,6 +23,18 @@ import jax
 import jax.numpy as jnp
 
 
+def pack_int4(lo: jax.Array, hi: jax.Array) -> jax.Array:
+    """Pack two int4 code planes (int8 arrays, same shape) into bytes."""
+    return ((lo & 0xF) | ((hi & 0xF) << 4)).astype(jnp.int8)
+
+
+def unpack_int4(packed: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Bytes → (lo, hi) sign-extended int8 code planes."""
+    lo = (packed << 4).astype(jnp.int8) >> 4
+    hi = packed >> 4  # arithmetic shift sign-extends the high nibble
+    return lo, hi
+
+
 def _block_reshape(x: jax.Array, block_size: int) -> Tuple[jax.Array, int]:
     flat = x.reshape(-1)
     pad = (-flat.size) % block_size
@@ -44,9 +56,7 @@ def quantize_blockwise(x: jax.Array, bits: int = 8, block_size: int = 256
     scale = jnp.where(scale == 0.0, 1.0, scale)
     codes = jnp.clip(jnp.round(blocks / scale), -qmax - 1, qmax).astype(jnp.int8)
     if bits == 4:
-        lo = codes[:, 0::2] & 0xF
-        hi = (codes[:, 1::2] & 0xF) << 4
-        codes = (lo | hi).astype(jnp.int8)
+        codes = pack_int4(codes[:, 0::2], codes[:, 1::2])
     return codes, scale[:, 0]
 
 
@@ -55,8 +65,7 @@ def dequantize_blockwise(codes: jax.Array, scales: jax.Array, bits: int = 8,
                          ) -> jax.Array:
     assert bits in (8, 4), bits
     if bits == 4:
-        lo = (codes << 4).astype(jnp.int8) >> 4  # sign-extend low nibble
-        hi = codes >> 4  # arithmetic shift sign-extends high nibble
+        lo, hi = unpack_int4(codes)
         blocks = jnp.stack([lo, hi], axis=-1).reshape(codes.shape[0], -1)
     else:
         blocks = codes

@@ -1,0 +1,73 @@
+"""Subprocess autotuner: real runner round-trips, failure capture, launcher
+command construction, and override→config mapping."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.autotuning.autotuner import (ExperimentScheduler,
+                                                SubprocessAutotuner,
+                                                apply_overrides)
+from deepspeed_tpu.runtime.config import AutotuningConfig
+
+TINY = {"preset": "tiny",
+        "overrides": {"hidden_size": 32, "intermediate_size": 64,
+                      "num_layers": 2, "num_heads": 2, "vocab_size": 128,
+                      "max_seq_len": 64}}
+BASE = {"train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000}
+
+CPU_ENV = {"DSTPU_PLATFORM": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+
+
+def test_apply_overrides_paths():
+    cfg = apply_overrides(BASE, {"zero_stage": 2, "micro_batch": 4,
+                                 "optimizer.params.lr": 5e-4})
+    assert cfg["zero_optimization"]["stage"] == 2
+    assert cfg["train_micro_batch_size_per_gpu"] == 4
+    assert cfg["optimizer"]["params"]["lr"] == 5e-4
+    assert BASE.get("zero_optimization") is None  # base untouched
+
+
+def test_launcher_command_prefix(tmp_path):
+    sched = ExperimentScheduler(str(tmp_path),
+                                launcher_args=["dstpu", "--hostfile", "hf"])
+    cmd = sched.command("s.json", "r.json")
+    assert cmd[:3] == ["dstpu", "--hostfile", "hf"]
+    assert "deepspeed_tpu.autotuning.experiment_runner" in cmd
+    assert "--spec" in cmd and "--result" in cmd
+
+
+@pytest.mark.slow
+def test_subprocess_sweep_end_to_end(tmp_path):
+    sched = ExperimentScheduler(str(tmp_path), env=CPU_ENV, timeout_s=600)
+    tuner = SubprocessAutotuner(
+        AutotuningConfig(fast=False), model=TINY, base_config=BASE,
+        space={"micro_batch": [1, 2]}, scheduler=sched, profile_steps=2,
+        seq_len=32)
+    best, exps = tuner.tune()
+    assert best["micro_batch"] in (1, 2)
+    assert sum(e.ok for e in exps) == 2
+    # the runner wrote real spec/result files (scheduler round-trip)
+    results = [f for f in os.listdir(tmp_path) if f.endswith("result.json")]
+    assert len(results) == 2
+    with open(tmp_path / results[0]) as f:
+        assert json.load(f)["ok"] is True
+
+
+@pytest.mark.slow
+def test_subprocess_failure_is_sweep_data(tmp_path):
+    sched = ExperimentScheduler(str(tmp_path), env=CPU_ENV, timeout_s=600)
+    tuner = SubprocessAutotuner(
+        AutotuningConfig(fast=False), model=TINY, base_config=BASE,
+        space={"zero_stage": [0, 99]},  # 99: invalid → recorded failure
+        scheduler=sched, profile_steps=1, seq_len=32)
+    best, exps = tuner.tune()
+    assert best == {"zero_stage": 0}
+    bad = [e for e in exps if not e.ok]
+    assert len(bad) == 1 and bad[0].config_overrides == {"zero_stage": 99}
+    assert bad[0].error

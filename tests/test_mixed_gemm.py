@@ -63,6 +63,38 @@ def test_unaligned_shapes_fall_back():
     assert dequantize_gemm_weight(qw4).shape == (99, 33)
 
 
+def test_ragged_m_stays_on_kernel_path():
+    # M=300 has no 8-aligned divisor: the pad-to-sublane path must keep the
+    # kernel (not silently dequantize the whole weight) and match the oracle
+    x = jax.random.normal(jax.random.PRNGKey(6), (300, 256), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(7), (256, 256), jnp.float32)
+    qw = quantize_gemm_weight(w, bits=8, group=256)
+    out = mixed_gemm(x, qw)
+    ref = x @ dequantize_gemm_weight(qw).astype(jnp.float32)
+    tol = 2e-2 * float(jnp.max(jnp.abs(ref))) + 1e-3
+    assert out.shape == (300, 256)
+    assert float(jnp.max(jnp.abs(out - ref))) < tol
+
+
+def test_quantized_tp_matches_single_device():
+    from deepspeed_tpu.inference.engine import InferenceConfig, InferenceEngine
+    from deepspeed_tpu.models import transformer as tfm
+
+    cfg = tfm.get_config("tiny", hidden_size=128, intermediate_size=256,
+                         num_layers=2, num_heads=4, vocab_size=512,
+                         max_seq_len=128)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = np.asarray([[5, 7, 11, 13]], np.int32)
+    outs = []
+    for tp in (1, 2):
+        eng = InferenceEngine(
+            model_config=cfg, params=params,
+            config=InferenceConfig(dtype="float32", tensor_parallel_size=tp,
+                                   quantize_bits=8))
+        outs.append(eng.generate(prompt, max_new_tokens=6))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
 def test_stacked_layers_slice_under_scan():
     L, K, N = 3, 256, 256
     w = jax.random.normal(jax.random.PRNGKey(4), (L, K, N), jnp.float32)
