@@ -60,6 +60,46 @@ def _check_microbatch_divisibility(B: int, topo, M: int) -> None:
             f"over dp*fsdp={b_shards} shards before microbatching)")
 
 
+def _resolve_stage_attention(cfg, attn_fn, topo, S: int):
+    """Decide whether the pipeline runs with an sp-sharded sequence.
+
+    Returns (seq_sharded, attn_fn): ``attn_fn`` is None when the bound
+    ulysses body must be constructed inside the shard_map (it needs the
+    local rope slice), a plain AttentionFn otherwise.
+    """
+    from ...models import transformer as tfm
+
+    sp = topo.size("sp")
+    if attn_fn is not None:
+        return False, attn_fn
+    if cfg.attn_impl == "ring" and sp > 1:
+        raise ValueError(
+            "attn_impl='ring' cannot run inside the pipelined stack (its "
+            "ppermute ring would nest the sp loop in every tick); use "
+            "'ulysses' for pp × sp or 'flash' for full-sequence stages")
+    if cfg.attn_impl == "ulysses" and sp > 1:
+        if S % sp != 0:
+            raise ValueError(f"seq len {S} not divisible by sp={sp}")
+        return True, None
+    impl = "flash" if cfg.attn_impl in ("ulysses", "ring") else cfg.attn_impl
+    return False, tfm.resolve_attention(impl)
+
+
+def _bind_stage_attention(seq_sharded: bool, attn_fn, cos, sin, s_l: int):
+    """Inside the pipeline shard_map: slice rope tables to this sp rank's
+    rows and bind the ulysses all-to-all attention when seq-sharded."""
+    if not seq_sharded:
+        return cos, sin, attn_fn
+    from ...sequence.ulysses import ulysses_attention_bound
+
+    r = lax.axis_index("sp")
+    cos_l = (lax.dynamic_slice_in_dim(cos, r * s_l, s_l)
+             if cos is not None else None)
+    sin_l = (lax.dynamic_slice_in_dim(sin, r * s_l, s_l)
+             if sin is not None else None)
+    return cos_l, sin_l, ulysses_attention_bound
+
+
 def _stage_fn(layer_params, x, cfg, attn_fn, cos, sin):
     """Run this stage's local slice of the layer stack (scan over L/P layers)."""
     from ...models import transformer as tfm
@@ -109,15 +149,7 @@ def pipeline_apply(layer_params: Dict[str, Any], x: jax.Array, cfg,
     B, S, H = x.shape
     M = num_microbatches
     _check_microbatch_divisibility(B, topo, M)
-    if cfg.attn_impl in ("ulysses", "ring") and attn_fn is None:
-        # distributed attention binds the 'sp' axis with its own shard_map,
-        # which cannot nest inside the pipeline's shard_map; within a stage
-        # the sequence is full anyway (x enters the pipeline unsharded on sp)
-        raise ValueError(
-            "attn_impl='ulysses'/'ring' cannot run inside the pipelined "
-            "stack; use 'flash' or 'xla' — each stage sees the full sequence")
-    if attn_fn is None:
-        attn_fn = tfm.resolve_attention(cfg.attn_impl)
+    seq_sharded, attn_fn = _resolve_stage_attention(cfg, attn_fn, topo, S)
 
     cos, sin = (None, None)
     if cfg.position == "rope":
@@ -131,6 +163,8 @@ def pipeline_apply(layer_params: Dict[str, Any], x: jax.Array, cfg,
         mb_l = b_l // M
         xm = x.reshape(M, mb_l, s_l, h_l)
         fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+        cos_l, sin_l, af = _bind_stage_attention(seq_sharded, attn_fn, cos,
+                                                 sin, s_l)
 
         def tick(carry, t):
             state, outputs = carry
@@ -139,7 +173,7 @@ def pipeline_apply(layer_params: Dict[str, Any], x: jax.Array, cfg,
             fresh = jnp.where(t < M, 1.0, 0.0).astype(x.dtype)
             inject = lax.dynamic_index_in_dim(xm, mb_idx, 0, keepdims=False)
             inp = jnp.where(me == 0, inject * fresh, state)
-            y = _stage_fn(layer_params, inp, cfg, attn_fn, cos, sin)
+            y = _stage_fn(layer_params, inp, cfg, af, cos_l, sin_l)
             # last stage collects finished microbatch (valid when t >= n-1)
             out_idx = jnp.clip(t - (n - 1), 0, M - 1)
             take = (t >= n - 1) & (t - (n - 1) < M)
@@ -157,11 +191,12 @@ def pipeline_apply(layer_params: Dict[str, Any], x: jax.Array, cfg,
         outputs = lax.psum(jnp.where(me == n - 1, outputs, 0.0), "pp")
         return outputs.reshape(b_l, s_l, h_l)
 
-    # activations enter the pipeline with the sequence axis UNsharded: the
-    # stage attention is computed over the full sequence (sp-sharded inputs
-    # are gathered here by GSPMD; see the ulysses/ring guard above)
+    # pp × sp composition: with attn_impl='ulysses' the sequence axis stays
+    # sp-sharded through the whole pipeline (stage boundaries included) and
+    # the stage attention does its head↔seq all-to-all on the bound sp axis;
+    # otherwise the sequence enters unsharded and stages see the full S
     batch_axes = ("dp", "fsdp")
-    x_spec = P(batch_axes, None, None)
+    x_spec = P(batch_axes, "sp" if seq_sharded else None, None)
     # layers axis of every param leaf sharded over pp
     param_spec = jax.tree.map(lambda _: P("pp"), layer_params)
     return shard_map(local, mesh=topo.mesh,
@@ -237,17 +272,20 @@ def _run_1f1b(layer_params, head_params, x, labels, mask, cfg, M, attn_fn,
     P_ = topo.size("pp")
     n = P_
     B, S, H = x.shape
+    seq_sharded, attn_fn = _resolve_stage_attention(cfg, attn_fn, topo, S)
     cos, sin = (None, None)
     if cfg.position == "rope":
         cos, sin = tfm.rope_table(S, cfg.rot_dim, cfg.rope_theta)
-
-    def stage(lp, xin):
-        return _stage_fn(lp, xin, cfg, attn_fn, cos, sin)
 
     def local(lp, hp, x, labels, mask):
         me = lax.axis_index("pp")
         b_l, s_l, h_l = x.shape
         mb_l = b_l // M
+        cos_l, sin_l, af = _bind_stage_attention(seq_sharded, attn_fn, cos,
+                                                 sin, s_l)
+
+        def stage(lp_, xin):
+            return _stage_fn(lp_, xin, cfg, af, cos_l, sin_l)
         xm = x.reshape(M, mb_l, s_l, h_l)
         lm = labels.reshape(M, mb_l, s_l)
         mm = mask.reshape(M, mb_l, s_l)
@@ -339,26 +377,28 @@ def _run_1f1b(layer_params, head_params, x, labels, mask, cfg, M, attn_fn,
         (in_buf, _, _, g_lp, g_hp, dx_buf, loss_sum,
          correct_sum), _ = lax.scan(tick, carry0, jnp.arange(T))
 
-        # reductions: batch axes shard the data → sum grads/loss across them;
-        # g_hp/loss live on the last pp stage, dx on stage 0 — psum selects
-        batch_axes = ("dp", "fsdp")
-        g_lp = jax.tree.map(lambda a: lax.psum(a, batch_axes), g_lp)
+        # reductions: data-sharding axes (batch; plus sp when the sequence
+        # is ulysses-sharded) sum grads/loss; g_hp/loss live on the last pp
+        # stage, dx on stage 0 — psum selects
+        data_axes = ("dp", "fsdp") + (("sp",) if seq_sharded else ())
+        g_lp = jax.tree.map(lambda a: lax.psum(a, data_axes), g_lp)
         g_hp = jax.tree.map(
             lambda a: lax.psum(
                 jnp.where(me == n - 1, a, jnp.zeros_like(a)),
-                batch_axes + ("pp",)),
+                data_axes + ("pp",)),
             g_hp)
         loss_sum = lax.psum(jnp.where(me == n - 1, loss_sum, 0.0),
-                            batch_axes + ("pp",))
+                            data_axes + ("pp",))
         correct_sum = lax.psum(jnp.where(me == n - 1, correct_sum, 0.0),
-                               batch_axes + ("pp",))
+                               data_axes + ("pp",))
         dx = lax.psum(jnp.where(me == 0, dx_buf, jnp.zeros_like(dx_buf)),
                       ("pp",))
         return g_lp, g_hp, dx.reshape(b_l, s_l, h_l), loss_sum, correct_sum
 
     batch_axes = ("dp", "fsdp")
-    x_spec = P(batch_axes, None, None)
-    lab_spec = P(batch_axes, None)
+    seq_axis = "sp" if seq_sharded else None
+    x_spec = P(batch_axes, seq_axis, None)
+    lab_spec = P(batch_axes, seq_axis)
     param_spec = jax.tree.map(lambda _: P("pp"), layer_params)
     head_spec = jax.tree.map(lambda _: P(), head_params)
     g_lp, g_hp, dx, loss_sum, correct_sum = shard_map(
@@ -426,12 +466,6 @@ def pipeline_loss_fn(params, batch, cfg, num_microbatches: int = 2,
         topo = get_topology()
         M = num_microbatches
         _check_microbatch_divisibility(B, topo, M)
-        if attn_fn is None:
-            if cfg.attn_impl in ("ulysses", "ring"):
-                raise ValueError(
-                    "attn_impl='ulysses'/'ring' cannot run inside the "
-                    "pipelined stack; use 'flash' or 'xla'")
-            attn_fn = tfm.resolve_attention(cfg.attn_impl)
         labels, mask = tfm.shift_labels(batch)
         if mask is None:
             mask = jnp.ones_like(labels, jnp.float32)

@@ -47,6 +47,32 @@ def _inner_attention(q, k, v, causal):
     return flash_attention(q, k, v, causal=causal)
 
 
+def ulysses_attention_bound(q: jax.Array, k: jax.Array, v: jax.Array,
+                            causal: bool = True, attn_fn=None,
+                            axis: str = "sp") -> jax.Array:
+    """Ulysses body for callers ALREADY inside a shard_map binding ``axis``
+    (e.g. the pipeline's stage shard_map — pp × sp composition): per-device
+    q (B_l, S/sp, H, D) → head↔seq all-to-all → full-sequence attention on
+    H/sp local heads → inverse all-to-all."""
+    sp = jax.lax.axis_size(axis)
+    inner = attn_fn or _inner_attention
+    H = q.shape[2]
+    KV = k.shape[2]
+    if H % sp != 0:
+        raise ValueError(f"ulysses requires heads({H}) % sp({sp}) == 0")
+    if KV % sp != 0:
+        rep = min_kv_replication(H, KV, sp)
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    a2a = partial(jax.lax.all_to_all, axis_name=axis, tiled=True)
+    q = a2a(q, split_axis=2, concat_axis=1)
+    k = a2a(k, split_axis=2, concat_axis=1)
+    v = a2a(v, split_axis=2, concat_axis=1)
+    o = inner(q, k, v, causal=causal)
+    # back: heads gathered, sequence re-sharded
+    return a2a(o, split_axis=1, concat_axis=2)
+
+
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       causal: bool = True,
                       attn_fn=None) -> jax.Array:
@@ -62,28 +88,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return _inner_attention(q, k, v, causal) if attn_fn is None \
             else attn_fn(q, k, v, causal=causal)
 
-    B, S, H, D = q.shape
-    KV = k.shape[2]
-    if H % sp != 0:
-        raise ValueError(f"ulysses requires heads({H}) % sp({sp}) == 0")
-    if KV % sp != 0:
-        rep = min_kv_replication(H, KV, sp)
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-
-    inner = attn_fn or _inner_attention
-    batch_spec = ("dp", "fsdp")
-
-    def local(q, k, v):
-        # local: (B_l, S/sp, H, D) → a2a → (B_l, S, H/sp, D)
-        q = jax.lax.all_to_all(q, "sp", split_axis=2, concat_axis=1, tiled=True)
-        k = jax.lax.all_to_all(k, "sp", split_axis=2, concat_axis=1, tiled=True)
-        v = jax.lax.all_to_all(v, "sp", split_axis=2, concat_axis=1, tiled=True)
-        o = inner(q, k, v, causal=causal)
-        # back: heads gathered, sequence re-sharded
-        return jax.lax.all_to_all(o, "sp", split_axis=1, concat_axis=2, tiled=True)
-
-    spec = P(batch_spec, "sp", None, None)
-    return shard_map(local, mesh=topo.mesh,
-                     in_specs=(spec, spec, spec),
+    spec = P(("dp", "fsdp"), "sp", None, None)
+    return shard_map(partial(ulysses_attention_bound, causal=causal,
+                             attn_fn=attn_fn),
+                     mesh=topo.mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
