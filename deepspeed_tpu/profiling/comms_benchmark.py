@@ -21,14 +21,23 @@ from ..comm import comm as dcomm
 from ..parallel.topology import MeshTopology
 
 
-def _bench_op(op_name: str, fn, x, n_iters: int = 10) -> float:
-    fn(x)  # compile
-    jax.block_until_ready(fn(x))
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
-        out = fn(x)
+def bench_fn(fn, *args, steps: int = 10, warmup: int = 2) -> float:
+    """Shared timing loop for the profiling suite: warmup (includes
+    compile), then mean wall-time over ``steps`` with a trailing
+    block_until_ready."""
+    out = None
+    for _ in range(max(1, warmup)):
+        out = fn(*args)
     jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / n_iters
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def _bench_op(op_name: str, fn, x, n_iters: int = 10) -> float:
+    return bench_fn(fn, x, steps=n_iters)
 
 
 def run_comms_benchmark(topo: MeshTopology, axis: str = "dp",

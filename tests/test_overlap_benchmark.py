@@ -1,0 +1,49 @@
+"""Overlap/fusion evidence benchmarks: structural smoke on the virtual mesh
+(the numbers only mean something on real hardware; the harness must run
+everywhere)."""
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.parallel.topology import MeshConfig, MeshTopology, \
+    set_topology
+from deepspeed_tpu.profiling.overlap_benchmark import (default_fusion_subject,
+                                                       fusion_report,
+                                                       offload_overlap_report,
+                                                       tp_overlap_report)
+
+
+def test_tp_overlap_report_structure(devices):
+    set_topology(MeshTopology.from_config(MeshConfig(tensor_parallel_size=4)))
+    rep = tp_overlap_report(hidden=128, layers=2, batch=2, seq=64, steps=2)
+    assert rep["tp"] == 4
+    for k in ("t_full_ms", "t_compute_ms", "t_comm_ms"):
+        assert rep[k] > 0
+    assert 0.0 <= rep["overlap_efficiency"] <= 1.0
+
+
+def test_offload_overlap_report(tmp_path):
+    rep = offload_overlap_report(param_mb=2.0, steps=3,
+                                 swap_dir=str(tmp_path))
+    assert rep["t_async_ms"] > 0 and rep["t_blocking_ms"] > 0
+    assert rep["speedup"] > 0
+
+
+def test_fusion_report_counts():
+    import jax.numpy as jnp
+
+    def f(x):
+        return (x * x + 1.0).sum()
+
+    rep = fusion_report(f, jnp.ones((128, 128)))
+    assert rep["jaxpr_eqns"] >= 2
+    assert rep["hlo_instructions"] >= 1
+
+
+def test_train_step_fusion_evidence():
+    rep = default_fusion_subject()
+    # the DeepCompile-role claim: a full grad step lowers to ONE program
+    # whose instruction count is the same order as the jaxpr, with real
+    # fusions present (not one kernel per op)
+    assert rep["jaxpr_eqns"] > 50
+    assert rep["hlo_fusions"] >= 1
