@@ -11,12 +11,52 @@ for BASELINE.json's "tokens/sec/chip at 8B ZeRO-3 ≥45% MFU on v5e-256" target.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 
+def _tpu_probe(timeout_s: float = 600.0, attempts: int = 2) -> bool:
+    """Probe accelerator availability in a SUBPROCESS with a hard timeout.
+
+    Round-2/3 lesson: the TPU plugin can *hang* during init (tunnel down), and
+    a hang inside this process is unrecoverable — no exception ever fires.  A
+    subprocess probe turns the hang into a catchable timeout; on failure we
+    pin this process to the host CPU so the bench still emits a record.
+    """
+    code = "import jax; jax.devices(); print(jax.default_backend())"
+    for attempt in range(attempts):
+        try:
+            r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                               capture_output=True, text=True)
+            if r.returncode == 0 and r.stdout.strip() not in ("", "cpu"):
+                return True
+            if r.returncode == 0:
+                # clean 'cpu' answer is deterministic — retrying cannot
+                # produce a TPU; don't burn 15s + another probe
+                sys.stderr.write("bench: no accelerator (cpu backend)\n")
+                return False
+            sys.stderr.write(f"bench: tpu probe attempt {attempt + 1} failed "
+                             f"(rc={r.returncode})\n")
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"bench: tpu probe attempt {attempt + 1} hung "
+                             f">{timeout_s:.0f}s\n")
+        if attempt < attempts - 1:
+            time.sleep(15.0)
+    return False
+
+
 def main() -> None:
+    if not _tpu_probe():
+        # No live TPU: force the CPU smoke path rather than hanging forever.
+        os.environ["DSTPU_ACCELERATOR"] = "cpu"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     import jax
 
     import deepspeed_tpu
@@ -105,5 +145,59 @@ def main() -> None:
     }))
 
 
+def _emit_failure(err: BaseException) -> None:
+    """Crash-proofing: the driver must ALWAYS get one structured JSON line.
+
+    Round-2 lesson: a TPU-plugin init error escaped ``main()`` and the round
+    ended with no perf record at all (VERDICT r02 item 1).  Any failure now
+    produces a machine-readable record instead of a stack trace.
+    """
+    import traceback
+
+    print(json.dumps({
+        "metric": "bench_failure",
+        "value": 0.0,
+        "unit": "mfu_fraction",
+        "vs_baseline": 0.0,
+        "extra": {
+            "error": f"{type(err).__name__}: {err}",
+            "traceback_tail": traceback.format_exc(limit=3).splitlines()[-3:],
+        },
+    }))
+
+
+def _start_watchdog(budget_s: float) -> None:
+    """A daemon THREAD (not SIGALRM): a hang inside native code (plugin init,
+    XLA compile) never returns to the interpreter, so a Python signal handler
+    would not run — a sleeping thread still does.  Writes the failure record
+    straight to fd 1 (bypassing block-buffered stdio) and hard-exits."""
+    import threading
+
+    def fire():
+        time.sleep(budget_s)
+        rec = json.dumps({
+            "metric": "bench_failure", "value": 0.0, "unit": "mfu_fraction",
+            "vs_baseline": 0.0,
+            "extra": {"error": f"watchdog: bench exceeded {budget_s:.0f}s"},
+        })
+        try:
+            sys.stdout.flush()
+        except Exception:
+            pass
+        os.write(1, (rec + "\n").encode())
+        os._exit(0)
+
+    threading.Thread(target=fire, daemon=True).start()
+
+
 if __name__ == "__main__":
-    main()
+    # Last line of defence: whatever happens — plugin hang after the probe,
+    # a pathological compile — one JSON line goes out before the driver's
+    # own timeout can strike.
+    _start_watchdog(float(os.environ.get("DSTPU_BENCH_BUDGET_S", "3000")))
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — never let the bench die silently
+        _emit_failure(e)
+        sys.stdout.flush()
+        raise SystemExit(0)

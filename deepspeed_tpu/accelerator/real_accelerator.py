@@ -6,10 +6,14 @@ override (``DS_ACCELERATOR``) plus auto-detection, cached per process.
 
 from __future__ import annotations
 
+import logging
 import os
+import time
 from typing import Optional
 
 from .abstract_accelerator import Accelerator
+
+logger = logging.getLogger("deepspeed_tpu")
 
 _accelerator: Optional[Accelerator] = None
 
@@ -17,6 +21,71 @@ _accelerator: Optional[Accelerator] = None
 def set_accelerator(accel: Accelerator) -> None:
     global _accelerator
     _accelerator = accel
+
+
+def _probe_default_backend(retries: int = 2, retry_delay_s: float = 15.0) -> str:
+    """Return ``jax.default_backend()``, surviving accelerator-plugin flakes.
+
+    A transient TPU-runtime error (plugin tunnel not yet up, libtpu grabbing a
+    lock, pod-slice neighbour restarting) must not take the whole process down
+    — the reference degrades to a working accelerator instead of raising
+    (``accelerator/real_accelerator.py:52``).  We retry backend discovery, and
+    on persistent failure force the host-CPU platform so every downstream
+    jax call still works.
+    """
+    import jax
+
+    last_err: Exception | None = None
+    for attempt in range(retries + 1):
+        try:
+            return jax.default_backend()
+        except Exception as e:  # RuntimeError / JaxRuntimeError from plugin init
+            last_err = e
+            if attempt < retries:
+                logger.warning(
+                    "accelerator backend init failed (attempt %d/%d): %s — "
+                    "retrying in %.0fs", attempt + 1, retries + 1, e, retry_delay_s)
+                time.sleep(retry_delay_s)
+                # Drop jax's cached failed-backend state so the retry re-probes.
+                _clear_jax_backend_cache()
+    if os.environ.get("DSTPU_REQUIRE_ACCELERATOR"):
+        # Multi-host pods must fail fast: one worker silently degrading to
+        # CPU would deadlock the others in the first collective.  Launchers
+        # set this; single-host/bench runs keep the degrade-and-continue
+        # default so a perf record still gets emitted.
+        raise RuntimeError(
+            f"accelerator backend unavailable after {retries + 1} attempts "
+            f"({last_err}) and DSTPU_REQUIRE_ACCELERATOR is set") from last_err
+    logger.error(
+        "accelerator backend unavailable after %d attempts (%s) — "
+        "DEGRADING TO HOST CPU (set DSTPU_REQUIRE_ACCELERATOR=1 to fail "
+        "fast instead; multi-host jobs should)", retries + 1, last_err)
+    # jax.config (not the JAX_PLATFORMS env var): this image's sitecustomize
+    # registers the TPU PJRT plugin at interpreter start, which wins over the
+    # env var — the config route is authoritative either way.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    _clear_jax_backend_cache()
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def _clear_jax_backend_cache() -> None:
+    """Drop jax's cached (failed) backend state so the next probe re-inits."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        with _xb._backend_lock:
+            _xb._backends.clear()
+            _xb._backend_errors.clear()
+            _xb._default_backend = None
+    except Exception:
+        pass
 
 
 def get_accelerator() -> Accelerator:
@@ -34,9 +103,7 @@ def get_accelerator() -> Accelerator:
         _accelerator = TPUAccelerator()
         return _accelerator
 
-    import jax
-
-    if jax.default_backend() == "cpu":
+    if _probe_default_backend() == "cpu":
         _accelerator = CPUAccelerator()
     else:
         _accelerator = TPUAccelerator()

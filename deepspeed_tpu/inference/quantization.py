@@ -99,8 +99,11 @@ def quantize_on_host(params: Dict[str, Any], bits: int,
         cpus = jax.local_devices(backend="cpu")
     except RuntimeError:  # platform-restricted build: quantize in place
         return quantize_model_params(params, bits=bits, group=group)
+    # device_put (not default_device + asarray): already-committed accelerator
+    # arrays are actually MOVED to host, keeping the no-fp-weights-on-chip
+    # guarantee even when params arrive as device arrays
+    host = jax.tree.map(lambda x: jax.device_put(x, cpus[0]), params)
     with jax.default_device(cpus[0]):
-        host = jax.tree.map(lambda x: jax.numpy.asarray(x), params)
         return quantize_model_params(host, bits=bits, group=group)
 
 

@@ -86,7 +86,7 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
     bs = v2.block_size
 
     def fwd(params, caches, token_ids, position_ids, seq_index, block_tables,
-            context_lens, logits_rows):
+            context_lens, logits_rows, chunk_start, chunk_len):
         T = token_ids.shape[0]
         x = params["embed"]["tokens"].astype(dt)[token_ids]  # (T, H)
         if model_cfg.position == "learned":
@@ -109,6 +109,13 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
         blk_ids = jnp.where(write_mask, blk_ids, scratch_block)
 
         nh, nkv, hd = model_cfg.num_heads, model_cfg.kv_heads, model_cfg.head_dim
+        # per-token scatter coordinates into the per-sequence chunk layout
+        # (max_seqs, Qp): row = sequence, col = offset within this step's
+        # chunk. Padding tokens carry seq_index -1 → negative row → dropped.
+        Qp = v2.max_tokens_per_step
+        row = jnp.clip(seq_index, 0, block_tables.shape[0] - 1)
+        qp_col = position_ids - chunk_start[row]
+        scat_row = jnp.where(seq_index >= 0, row, -1)
 
         def layer_body(x, inp):
             lp, k_cache, v_cache = inp
@@ -124,9 +131,18 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
                 k = tfm.apply_rope(k[None], cos, sin)[0]
             k_cache = k_cache.at[blk_ids, offsets].set(k.astype(k_cache.dtype))
             v_cache = v_cache.at[blk_ids, offsets].set(v.astype(v_cache.dtype))
-            o = ragged_attention_xla(q, k_cache, v_cache, block_tables,
-                                     context_lens, seq_index, position_ids,
-                                     model_cfg, bs)
+            # chunked-prefill attention over paged KV: reorganize the ragged
+            # (T, H, D) q into per-sequence chunks and run the paged Pallas
+            # prefill kernel — never materializes the old (T, S_max, KV, D)
+            # per-token gather
+            from ...ops.pallas.paged_attention import paged_prefill_attention
+
+            q_seq = jnp.zeros((block_tables.shape[0], Qp, nh, hd), q.dtype)
+            q_seq = q_seq.at[scat_row, qp_col].set(q, mode="drop")
+            o_seq = paged_prefill_attention(q_seq, k_cache, v_cache,
+                                            block_tables, chunk_start,
+                                            chunk_len)
+            o = o_seq[row, qp_col]  # (T, H, D); padding rows read garbage
             attn_out = tfm._lin(o.reshape(T, nh * hd), lp["attn"], "wo", "bo")
             m_src = x if model_cfg.parallel_residual else x + attn_out
             m_in = tfm._norm(m_src, lp["ln2"], model_cfg.norm,
@@ -450,7 +466,8 @@ class InferenceEngineV2:
             self.params, self.caches,
             jnp.asarray(batch.token_ids), jnp.asarray(batch.position_ids),
             jnp.asarray(batch.seq_index), jnp.asarray(batch.block_tables),
-            jnp.asarray(batch.context_lens), jnp.asarray(batch.logits_rows))
+            jnp.asarray(batch.context_lens), jnp.asarray(batch.logits_rows),
+            jnp.asarray(batch.chunk_start), jnp.asarray(batch.chunk_len))
         if temperature > 0.0:
             if rng is None:
                 self._rng, rng = jax.random.split(self._rng)

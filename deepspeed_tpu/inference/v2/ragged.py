@@ -114,6 +114,8 @@ class RaggedBatch:
     block_tables: np.ndarray  # (max_seqs, max_blocks_per_seq) int32
     context_lens: np.ndarray  # (max_seqs,) int32 — tokens in cache AFTER this step
     logits_rows: np.ndarray  # (max_seqs,) int32 — flat index of each seq's last token
+    chunk_start: np.ndarray  # (max_seqs,) int32 — abs pos of row's first token
+    chunk_len: np.ndarray  # (max_seqs,) int32 — tokens scheduled for the row
     num_tokens: int
     num_seqs: int
     uids: List[int]
@@ -204,6 +206,8 @@ class RaggedBatchBuilder:
         block_tables = np.zeros((self.max_seqs, self.max_blocks_per_seq), np.int32)
         context_lens = np.zeros(self.max_seqs, np.int32)
         logits_rows = np.zeros(self.max_seqs, np.int32)
+        chunk_start = np.zeros(self.max_seqs, np.int32)
+        chunk_len = np.zeros(self.max_seqs, np.int32)
         uids = []
         cursor = 0
         for row, (seq, n_new) in enumerate(seqs):
@@ -218,7 +222,10 @@ class RaggedBatchBuilder:
             block_tables[row, :len(seq.blocks)] = seq.blocks
             context_lens[row] = start + len(new_tokens)
             logits_rows[row] = cursor + len(new_tokens) - 1
+            chunk_start[row] = start
+            chunk_len[row] = len(new_tokens)
             cursor += len(new_tokens)
             uids.append(seq.uid)
         return RaggedBatch(token_ids, position_ids, seq_index, block_tables,
-                           context_lens, logits_rows, cursor, len(seqs), uids)
+                           context_lens, logits_rows, chunk_start, chunk_len,
+                           cursor, len(seqs), uids)

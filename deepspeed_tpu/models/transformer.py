@@ -452,6 +452,12 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
     from jax.ad_checkpoint import checkpoint_name
 
     def layer_body(carry, layer_params):
+        # ZeRO-Infinity param streaming: when the engine enabled offload_param,
+        # this layer's slice rides host→device DMA here (and the remat'd
+        # backward re-streams it); otherwise identity.
+        from ..runtime.zero.param_offload import maybe_stream_in
+
+        layer_params = maybe_stream_in(layer_params)
         h = carry
         a_in = _norm(h, layer_params["ln1"], cfg.norm, cfg.norm_eps)
         attn_out = _attention_block(a_in, layer_params["attn"], cfg, cos, sin,

@@ -42,10 +42,14 @@ from .pallas.flash_attention import (NUM_LANES, NUM_SUBLANES, _flash_fwd,
                                      _interpret, aligned_divisor)
 
 
-def _chunk_size(n: int, h: int, l_q: int, l_k: int,
+def _chunk_size(n: int, b: int, h: int, l_q: int, l_k: int,
                 budget_bytes: int = 1 << 28) -> int:
-    """Largest divisor of N whose per-chunk dS tile fits the budget."""
-    per_row = max(1, h * l_q * l_k * 4)
+    """Largest divisor of N whose per-chunk backward tiles fit the budget.
+
+    Per N-row the backward materialises (B, H, Lq, Lk) float32 score-shaped
+    tensors, and ~3 of them coexist (p, dp, ds) — budget all of them.
+    """
+    per_row = max(1, b * h * l_q * l_k * 4 * 3)
     cap = max(1, budget_bytes // per_row)
     for c in range(min(n, cap), 0, -1):
         if n % c == 0:
@@ -113,7 +117,7 @@ def _evo_bwd(has_b1, has_b2, res, g):
     f32 = jnp.float32
 
     delta = jnp.sum(g.astype(f32) * out.astype(f32), axis=-1)  # (B,N,Lq,H)
-    C = _chunk_size(N, H, Lq, Lk)
+    C = _chunk_size(N, B, H, Lq, Lk)
     nc = N // C
 
     def chunk(x):  # (B, N, ...) → (nc, B, C, ...)

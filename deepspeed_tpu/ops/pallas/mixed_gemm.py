@@ -178,8 +178,11 @@ def mixed_gemm(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     pad_m = (-M) % 8
     tm = aligned_divisor(M + pad_m, 256)
     tn = aligned_divisor(N, 256, 128)
+    # int4 packs two codes per byte, so its group must be even; int8 (kpack=1)
+    # has no such constraint — gating it too would push odd-group int8 weights
+    # off the kernel path for no reason
     usable = (tm is not None and tn is not None and K % qw.group == 0
-              and qw.group % 2 == 0
+              and (qw.bits != 4 or qw.group % 2 == 0)
               and (qw.group % 128 == 0 or qw.group == K))
     if usable:
         xp = jnp.pad(x2, ((0, pad_m), (0, 0))) if pad_m else x2

@@ -195,10 +195,20 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     params = jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s.sharding),
                           params, engine.state.params)
 
+    # delayed-update (DPU) pending gradients predate the load: applying them
+    # to the restored params would corrupt the restore — discard
+    if getattr(engine, "_pending_grads", None) is not None:
+        engine._pending_grads = None
+        engine._pending_lr_scale = None
+
     if getattr(engine, "offloaded_optimizer", None) is not None:
         # rebuild the fp32 master from the loaded params — otherwise the next
         # step would overwrite them with updates from the stale master
         engine.offloaded_optimizer.reset_master(params)
+        if getattr(engine, "zenflow_optimizer", None) is not None:
+            # stale device-side hot columns/accumulator would scatter pre-load
+            # values over the restored weights — force re-selection
+            engine.zenflow_optimizer.reset_after_load()
         if load_optimizer_states:
             flat_opt = _load_tree_flat(
                 os.path.join(ckpt_dir, "optimizer.safetensors"))

@@ -116,6 +116,12 @@ class OffloadOptimizerConfig(DSConfigModel):
     pipeline_write: bool = True
     fast_init: bool = False
     ratio: float = 1.0  # fraction of optimizer state kept on host
+    # One-step delayed parameter update (ZeRO-Offload DPU / SuperOffload
+    # overlap): the host applies step N's update while the device computes
+    # step N+1's gradients — step time ≈ max(device, host) instead of the sum.
+    # Gradients used for the update are stale by one step (the documented
+    # DPU trade-off; reference superoffload_stage3.py / pipelined swapper).
+    delayed_update: bool = False
 
 
 class ZeroConfig(DSConfigModel):

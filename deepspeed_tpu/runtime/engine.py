@@ -195,6 +195,47 @@ class TrainingEngine:
         off = config.zero_optimization.offload_optimizer
         self.offload_enabled = off is not None and off.device_str != "none"
         self.offloaded_optimizer = None
+
+        # ZeRO-Infinity param offload: stacked layer params live in the host
+        # memory space and stream per-layer inside the scanned program
+        # (zero/param_offload.py; reference partitioned_param_swapper.py).
+        off_p = config.zero_optimization.offload_param
+        self.param_offload_enabled = off_p is not None and \
+            off_p.device_str != "none"
+        if self.param_offload_enabled:
+            from .zero.param_offload import (apply_host_memory_kind,
+                                             host_memory_available,
+                                             offload_mask,
+                                             set_param_streaming)
+
+            if self.fp16_enabled:
+                raise ConfigError(
+                    "fp16 + offload_param is not supported; use bf16")
+            if not host_memory_available():
+                logger.warning(
+                    "offload_param requested but this backend exposes no "
+                    "pinned_host memory space — params stay in device memory")
+                self.param_offload_enabled = False
+            else:
+                thresh = config.zero_optimization.stage3_param_persistence_threshold
+                # "auto" keeps small per-layer tensors (norm scales, biases)
+                # device-resident — the reference's auto resolves to ~10×
+                # hidden elements; 1e5 is that order for typical models.
+                # Offloading them would add a tiny host DMA per layer per
+                # step for negligible HBM savings.
+                thresh = 100_000 if isinstance(thresh, str) else int(thresh)
+                self._param_offload_mask = offload_mask(
+                    model.params, model.param_axes, min_numel=thresh)
+                self.param_shardings = apply_host_memory_kind(
+                    self.param_shardings, self._param_offload_mask)
+                set_param_streaming(True)
+                if not self.offload_enabled:
+                    # params off-device imply the fp32 master + update live on
+                    # the host too (there is no device copy to update)
+                    from .config import OffloadOptimizerConfig
+
+                    off = OffloadOptimizerConfig(device="cpu")
+                    self.offload_enabled = True
         if self.offload_enabled and self.fp16_enabled:
             raise ConfigError(
                 "fp16 + offload_optimizer is not supported; use bf16")
@@ -230,11 +271,36 @@ class TrainingEngine:
         self.state = self._init_state()
 
         # ---- step function -------------------------------------------
+        self._delayed_update = False
+        self._pending_grads = None
+        self._pending_lr_scale = None
+        self.zenflow_optimizer = None
+        if config.zenflow.enabled and not self.offload_enabled:
+            raise ConfigError(
+                "zenflow requires offload_optimizer (it is a stall-free "
+                "*offload* schedule; reference zenflow_stage_1_and_2.py)")
+        if config.zenflow.enabled and self.param_offload_enabled:
+            raise ConfigError(
+                "zenflow + offload_param is not supported (the hot-column "
+                "scatter needs device-resident params)")
         if self.offload_enabled:
             from .zero.offload import OffloadedOptimizer
 
             self.offloaded_optimizer = OffloadedOptimizer(
-                self.optimizer, self.state.params, off, aio=config.aio)
+                self.optimizer, self.state.params, off, aio=config.aio,
+                param_cfg=config.zero_optimization.offload_param)
+            self._delayed_update = bool(getattr(off, "delayed_update", False))
+            if config.zenflow.enabled:
+                from .zenflow import ZenFlowOptimizer
+
+                self.zenflow_optimizer = ZenFlowOptimizer(
+                    self.optimizer, self.state.params, config.zenflow,
+                    host_opt=self.offloaded_optimizer)
+                if self._delayed_update:
+                    logger.warning(
+                        "zenflow already removes the per-step offload stall; "
+                        "ignoring delayed_update")
+                    self._delayed_update = False
             self._grad_step = self._build_grad_step()
         else:
             self._train_step = self._build_train_step()
@@ -298,9 +364,22 @@ class TrainingEngine:
         # out from under the user (or a second engine sharing the ModelSpec).
         # A jitted copy guarantees new buffers (device_put may alias even with
         # may_alias=False when the sharding already matches).
-        params = jax.jit(
-            lambda t: jax.tree.map(jnp.copy, t),
-            out_shardings=self.param_shardings)(self.model.params)
+        if self.param_offload_enabled:
+            # the jitted copy cannot carry mixed memory kinds (the placement
+            # custom-call defeats the SPMD partitioner): copy with device
+            # kinds, then move the host-space leaves eagerly
+            dev_sh = jax.tree.map(
+                lambda s: s.with_memory_kind("device")
+                if s.memory_kind == "pinned_host" else s, self.param_shardings)
+            params = jax.jit(
+                lambda t: jax.tree.map(jnp.copy, t),
+                out_shardings=dev_sh)(self.model.params)
+            params = jax.tree.map(lambda x, s: jax.device_put(x, s),
+                                  params, self.param_shardings)
+        else:
+            params = jax.jit(
+                lambda t: jax.tree.map(jnp.copy, t),
+                out_shardings=self.param_shardings)(self.model.params)
         if self.offload_enabled:
             # optimizer state lives on host (OffloadedOptimizer); keep no
             # device copy at all — that's the memory savings offload buys
@@ -546,6 +625,13 @@ class TrainingEngine:
             metrics["grad_norm"] = optax.global_norm(grads)
             return grads, metrics, rng
 
+        # NOTE on grads: ideally the stacked layer grads would land in
+        # pinned_host via out_shardings (per-scan-step writeback), but this
+        # XLA version's SPMD partitioner rejects memory-kind annotations at
+        # the jit boundary under a mesh ("side-effect ops cannot be
+        # replicated"); grads therefore return in device memory and move to
+        # host in OffloadedOptimizer.step's device_get.  Host-space *inputs*
+        # (the streamed params) are unaffected.
         return jax.jit(step_fn)
 
     def _train_batch_offloaded(self, placed, lr_scale=None
@@ -555,16 +641,56 @@ class TrainingEngine:
             lr *= float(lr_scale)
         grads, metrics, rng = self._grad_step(self.state.params, placed,
                                               self.state.rng)
-        new_params = self.offloaded_optimizer.step(grads, lr_scale=lr_scale)
-        new_params = jax.tree.map(
-            lambda x, s: jax.device_put(x, s), new_params, self.param_shardings)
-        self.state = EngineState(
-            step=self.state.step + 1, params=new_params,
-            opt_state=self.state.opt_state, loss_scale=self.state.loss_scale,
-            rng=rng, skipped_steps=self.state.skipped_steps)
+        # the grad step is DISPATCHED, not awaited: start NVMe read-ahead of
+        # master/moments now so disk IO overlaps the device compute
+        self.offloaded_optimizer.prefetch()
+        if self.zenflow_optimizer is not None:
+            # ZenFlow: hot columns update on device now; cold grads stay on
+            # device and flush through the host optimizer every interval
+            new_params = self.zenflow_optimizer.step(
+                self.state.params, grads, lr_scale=lr_scale)
+        elif self._delayed_update:
+            # DPU overlap: the grad step above is DISPATCHED (async) — while
+            # the device runs batch N, the host applies batch N-1's update
+            # (its grads are already materialized) and pushes params for
+            # batch N+1.  Step time ≈ max(device, host) — the SuperOffload /
+            # pipelined-swapper dataflow (superoffload_stage3.py:1,
+            # pipelined_optimizer_swapper.py:52).
+            if self._pending_grads is not None:
+                new_params = self.offloaded_optimizer.step(
+                    self._pending_grads, lr_scale=self._pending_lr_scale)
+                new_params = jax.tree.map(
+                    lambda x, s: jax.device_put(x, s), new_params,
+                    self.param_shardings)
+            else:  # first step: nothing to apply yet
+                new_params = self.state.params
+            self._pending_grads = grads
+            self._pending_lr_scale = lr_scale
+        else:
+            new_params = self.offloaded_optimizer.step(grads, lr_scale=lr_scale)
+            new_params = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), new_params,
+                self.param_shardings)
+        self.state = dataclasses.replace(
+            self.state, step=self.state.step + 1, params=new_params, rng=rng)
         out = {k: float(v) for k, v in metrics.items()}
         out["lr"] = lr
         return out
+
+    def flush_delayed_update(self) -> None:
+        """Apply the pending (one-step-delayed) update, if any.  Called
+        automatically before checkpoint save and eval; end-of-training code
+        should call it too so the last batch's gradients are not dropped."""
+        if getattr(self, "_pending_grads", None) is None:
+            return
+        new_params = self.offloaded_optimizer.step(
+            self._pending_grads, lr_scale=self._pending_lr_scale)
+        self._pending_grads = None
+        self._pending_lr_scale = None
+        new_params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), new_params,
+            self.param_shardings)
+        self.state = dataclasses.replace(self.state, params=new_params)
 
     def _build_eval_step(self):
         loss_fn = self.model.eval_fn or self.model.loss_fn
@@ -632,6 +758,7 @@ class TrainingEngine:
 
         Returns a Mapping (LazyMetrics): reads materialize floats; convert
         with ``dict(m)`` for serialization.  Not a dict instance."""
+        self._assert_streaming_flag()
         self.tput.start()
         lr_scale = None
         if "lr_scale" in batch:  # variable-batch LR (data_sampling)
@@ -669,7 +796,17 @@ class TrainingEngine:
 
         return shard_accounting(self.state.params, self.param_shardings)
 
+    def _assert_streaming_flag(self) -> None:
+        """Pin the trace-time param-streaming flag to THIS engine's mode right
+        before any call that may trace — engines with different offload_param
+        settings can then coexist in one process (tests, hybrid setups)."""
+        from .zero.param_offload import set_param_streaming
+
+        set_param_streaming(self.param_offload_enabled)
+
     def eval_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        self._assert_streaming_flag()
+        self.flush_delayed_update()
         placed = self._place_batch(batch)
         flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), placed)
         metrics = self._eval_step(self.state, flat)
@@ -707,6 +844,11 @@ class TrainingEngine:
 
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None) -> str:
+        self.flush_delayed_update()
+        if self.zenflow_optimizer is not None:
+            # mid-interval cold gradients must not be dropped by the save
+            new_params = self.zenflow_optimizer.flush(self.state.params)
+            self.state = dataclasses.replace(self.state, params=new_params)
         from .checkpoint.engine import save_checkpoint as _save
 
         return _save(self, save_dir, tag=tag, client_state=client_state or {})

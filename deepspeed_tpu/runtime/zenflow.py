@@ -1,20 +1,37 @@
 """ZenFlow — importance-aware selective updates for stall-free offloading.
 
 Capability analogue of the reference's ``runtime/zenflow/``
-(``zenflow_stage_1_and_2.py`` + ``ops/adam/zenflow_torch_adam.py``): the
-top-k most important gradient columns are applied *immediately* (on device,
-cheap), while the long tail accumulates and is applied on the host
-asynchronously every ``update_interval`` steps — eliminating the per-step
-device stall of full optimizer offload (>4000× gradient-traffic reduction
-claim in the reference blog).
+(``zenflow_stage_1_and_2.py:47`` — a ZeRO-optimizer subclass selected by
+config — plus ``ops/adam/zenflow_torch_adam.py``): the top-k most important
+gradient *columns* are applied immediately on the device with their own
+compact optimizer state, while the long tail accumulates and flushes through
+the offloaded host optimizer every ``update_interval`` steps — eliminating
+the per-step device→host gradient stall of plain optimizer offload
+(the ">4000× gradient-transfer reduction" of the reference blog).
 
-Functional decomposition here:
-* ``select_topk_columns`` — per-matrix column importance (squared-grad norm),
-  reference's per-column proxy;
-* ``zenflow_partition`` — split a grad pytree into (hot, cold) by the masks;
-* ``ZenFlowOptimizer`` — device applies hot updates each step; cold grads
-  accumulate on host and a full (offloaded) update runs every
-  ``update_interval`` steps.
+Design (all stall-free properties by construction):
+
+* **hot path** (every step, on device): per-matrix top-k columns are gathered
+  into compact buffers — fp32 master columns + the user optimizer's state
+  *initialized on the compact tree* (optax is shape-polymorphic, so the same
+  optimizer runs on (rows, k) slices) — updated, and scattered back into the
+  compute params.  Device optimizer-state memory is O(topk_ratio), not
+  O(params): the offload memory win survives.
+* **cold path**: the non-selected gradient columns accumulate into a
+  device-resident buffer — NO device→host transfer happens on the step path.
+  Every ``update_interval`` steps the accumulated mean moves to the host once
+  (amortized) and flushes through the offloaded host optimizer
+  (``zero/offload.py OffloadedOptimizer`` — DRAM or NVMe tier).
+* **reconciliation**: before each flush the compact fp32 master syncs into
+  the host master (hot columns are authoritative on device); after the flush
+  the hot columns are re-applied on top of the host result, so the two
+  update streams never double-apply.
+* **re-selection** every ``select_interval`` steps re-picks the columns from
+  the current gradients and re-initializes the compact state (the
+  reference's epoch/step selection strategies).
+
+Transfer accounting is exposed (``cold_bytes_transferred``) so tests and the
+overlap benchmark can assert the step path moves zero cold bytes.
 """
 
 from __future__ import annotations
@@ -52,54 +69,215 @@ def zenflow_partition(grads: Any, topk_ratio: float, return_masks: bool = False)
     return hot, cold
 
 
-class ZenFlowOptimizer:
-    """Wraps a device optimizer (hot path) + a host accumulator (cold path).
+def _k_for(leaf, ratio: float) -> int:
+    return max(1, int(leaf.shape[-1] * ratio))
 
-    step(params, grads) → new params. Device update applies only the hot
-    columns every step; cold gradients accumulate host-side and flush through
-    the same optimizer every ``update_interval`` steps (the reference's
-    asynchronous CPU update, synchronous here but off the per-step critical
-    path by construction of the interval)."""
+
+def _is_matrix(leaf) -> bool:
+    return hasattr(leaf, "ndim") and leaf.ndim >= 2
+
+
+class ZenFlowOptimizer:
+    """Selective device update + interval-flushed offloaded cold update.
+
+    ``step(params, grads, lr_scale=None) -> new_params`` (device arrays in
+    and out).  ``host_opt`` is an ``OffloadedOptimizer`` owning the full fp32
+    master and optimizer state on the host; when omitted, one is created with
+    ``device='cpu'`` (standalone/test mode).
+    """
 
     def __init__(self, optimizer: optax.GradientTransformation, params: Any,
-                 cfg: ZenFlowConfig):
+                 cfg: ZenFlowConfig, host_opt=None):
         self.optimizer = optimizer
         self.cfg = cfg
         self.update_interval = (4 if cfg.update_interval in (None, "auto")
                                 else int(cfg.update_interval))
-        self.opt_state = optimizer.init(params)
-        self._cold_acc = jax.tree.map(
-            lambda p: np.zeros(p.shape, np.float32), params)
+        sel = cfg.select_interval
+        self.select_interval = (4 * self.update_interval
+                                if sel in (None, "auto") else int(sel))
+        if host_opt is None:
+            from .config import OffloadOptimizerConfig
+            from .zero.offload import OffloadedOptimizer
+
+            host_opt = OffloadedOptimizer(
+                optimizer, params, OffloadOptimizerConfig(device="cpu"))
+        self.host_opt = host_opt
+
         self._step = 0
+        self._indices: Optional[Any] = None  # per-matrix (k,) int32
+        self._hot_master: Optional[Any] = None  # compact fp32 columns
+        self._hot_state: Optional[Any] = None  # optimizer state on compact
+        self._cold_acc: Optional[Any] = None  # device-resident accumulator
+        self.cold_bytes_transferred = 0  # flush-only D2H accounting
+        self._steps_since_flush = 0
 
-        def hot_update(params, grads, opt_state):
-            hot, cold, masks = zenflow_partition(grads, cfg.topk_ratio,
-                                                 return_masks=True)
-            updates, new_state = optimizer.update(hot, opt_state, params)
-            # mask the UPDATES too: the shared momentum would otherwise keep
-            # nudging cold columns every step from stale state, double-applying
-            # cold gradients between flushes
-            updates = jax.tree.map(lambda u, m: u * m.astype(u.dtype),
-                                   updates, masks)
-            return optax.apply_updates(params, updates), new_state, cold
+        def select(grads):
+            def one(g):
+                if not _is_matrix(g):
+                    return jnp.zeros((0,), jnp.int32)  # marker: always-hot
+                energy = jnp.sum(jnp.square(g.astype(jnp.float32)),
+                                 axis=tuple(range(g.ndim - 1)))
+                _, idx = jax.lax.top_k(energy, _k_for(g, cfg.topk_ratio))
+                return idx.astype(jnp.int32)
 
-        def cold_update(params, cold_sum, opt_state):
-            updates, new_state = optimizer.update(cold_sum, opt_state, params)
-            return optax.apply_updates(params, updates), new_state
+            return jax.tree.map(one, grads)
 
-        self._hot = jax.jit(hot_update)
-        self._cold = jax.jit(cold_update)
+        def gather_compact(tree, indices):
+            return jax.tree.map(
+                lambda x, i: jnp.take(x, i, axis=-1).astype(jnp.float32)
+                if _is_matrix(x) else x.astype(jnp.float32),
+                tree, indices)
 
-    def step(self, params: Any, grads: Any) -> Any:
+        def hot_step(params, grads, indices, hot_master, hot_state, cold_acc,
+                     lr_scale):
+            gc = gather_compact(grads, indices)
+            updates, new_state = optimizer.update(gc, hot_state, hot_master)
+            # variable-batch LR multiplier applies to the hot stream too —
+            # the cold flush scales independently at its own step
+            updates = jax.tree.map(lambda u: u * lr_scale, updates)
+            new_master = optax.apply_updates(hot_master, updates)
+
+            def put_back(p, i, mc):
+                if not _is_matrix(p):
+                    return mc.astype(p.dtype)
+                return p.at[..., i].set(mc.astype(p.dtype))
+
+            new_params = jax.tree.map(put_back, params, indices, new_master)
+
+            def cold_of(g, i):
+                if not _is_matrix(g):
+                    return jnp.zeros_like(g, jnp.float32)
+                return g.astype(jnp.float32).at[..., i].set(0.0)
+
+            new_cold = jax.tree.map(
+                lambda a, g, i: a + cold_of(g, i), cold_acc, grads, indices)
+            return new_params, new_master, new_state, new_cold
+
+        def reapply_hot(params, indices, hot_master):
+            def put_back(p, i, mc):
+                if not _is_matrix(p):
+                    return mc.astype(p.dtype)
+                return p.at[..., i].set(mc.astype(p.dtype))
+
+            return jax.tree.map(put_back, params, indices, hot_master)
+
+        self._select = jax.jit(select)
+        self._gather_compact = jax.jit(gather_compact)
+        self._hot_step = jax.jit(hot_step)
+        self._reapply_hot = jax.jit(reapply_hot)
+
+    # -- selection ------------------------------------------------------
+
+    def _reselect(self, params, grads) -> None:
+        """(Re)pick hot columns from current grads; rebuild compact state.
+
+        fp32 residue of departing columns lives in the host master (synced at
+        the previous flush); compact state for entering columns starts fresh
+        (the reference resets per-column moments on re-selection too)."""
+        self._indices = self._select(grads)
+        self._hot_master = self._gather_compact(params, self._indices)
+        self._hot_state = jax.jit(self.optimizer.init)(self._hot_master)
+        if self._cold_acc is None:
+            self._cold_acc = jax.tree.map(
+                lambda g: jnp.zeros(g.shape, jnp.float32), grads)
+
+    # -- reconciliation -------------------------------------------------
+
+    def _sync_hot_into_host_master(self) -> None:
+        """Write the authoritative device hot columns into the host master."""
+        idx_host = jax.device_get(self._indices)
+        hot_host = jax.device_get(self._hot_master)
+        master = jax.device_get(self.host_opt.master_for_checkpoint()
+                                if hasattr(self.host_opt, "master_for_checkpoint")
+                                else self.host_opt.master)
+
+        def sync(m, i, h):
+            m = np.array(m, np.float32)
+            if i.shape[0] == 0:  # always-hot leaf: device value wins entirely
+                return np.asarray(h, np.float32)
+            m[..., i] = h
+            return m
+
+        new_master = jax.tree.map(sync, master, idx_host, hot_host)
+        self.host_opt.master = jax.device_put(new_master, self.host_opt.cpu)
+        if getattr(self.host_opt, "_param_nvme", False):
+            self.host_opt._master_out()
+
+    # -- the step -------------------------------------------------------
+
+    def step(self, params: Any, grads: Any, lr_scale=None) -> Any:
         self._step += 1
-        params, self.opt_state, cold = self._hot(params, grads, self.opt_state)
-        cold_host = jax.device_get(cold)
-        self._cold_acc = jax.tree.map(lambda a, c: a + np.asarray(c, np.float32),
-                                      self._cold_acc, cold_host)
+        # (step-1) % sel == 0 handles every legal interval, including the
+        # reference's per-step strategy (sel=1); `% sel == 1` would never
+        # fire for sel=1 and could land mid-interval for sel ∤ update_interval
+        reselect_due = self._indices is None or (
+            self.select_interval > 0 and self._step > 1
+            and (self._step - 1) % self.select_interval == 0)
+        if reselect_due:
+            # re-selection is only sound on a flush boundary: pending cold
+            # contributions in the about-to-be-hot columns and unsynced hot
+            # masters in the departing columns would otherwise be dropped
+            if self._steps_since_flush > 0:
+                params = self._flush(params, lr_scale)
+            self._reselect(params, grads)
+        params, self._hot_master, self._hot_state, self._cold_acc = \
+            self._hot_step(params, grads, self._indices, self._hot_master,
+                           self._hot_state, self._cold_acc,
+                           jnp.float32(1.0 if lr_scale is None else lr_scale))
+        self._steps_since_flush += 1
         if self._step % self.update_interval == 0:
-            scale = 1.0 / self.update_interval
-            cold_mean = jax.tree.map(lambda a: jnp.asarray(a * scale),
-                                     self._cold_acc)
-            params, self.opt_state = self._cold(params, cold_mean, self.opt_state)
-            self._cold_acc = jax.tree.map(lambda a: a * 0.0, self._cold_acc)
+            params = self._flush(params, lr_scale)
         return params
+
+    def flush(self, params: Any, lr_scale=None) -> Any:
+        """Apply any partially-accumulated cold gradients now (checkpoint
+        boundary — saving mid-interval must not drop them)."""
+        if self._steps_since_flush == 0:
+            return params
+        return self._flush(params, lr_scale)
+
+    def _flush(self, params: Any, lr_scale=None) -> Any:
+        """Amortized cold update: ONE D2H of the accumulated cold mean, host
+        optimizer step, hot columns re-applied on top."""
+        scale = 1.0 / max(1, self._steps_since_flush)
+        self._steps_since_flush = 0
+        cold_mean = jax.tree.map(lambda a: a * scale, self._cold_acc)
+        self._sync_hot_into_host_master()
+        cold_host = jax.device_get(cold_mean)  # the single amortized transfer
+        self.cold_bytes_transferred += sum(
+            int(np.asarray(c).nbytes) for c in jax.tree.leaves(cold_host))
+        new_params = self.host_opt.step(cold_host, lr_scale=lr_scale)
+        new_params = jax.tree.map(
+            lambda n, p: jax.device_put(jnp.asarray(n), p.sharding),
+            new_params, params)
+        new_params = self._reapply_hot(new_params, self._indices,
+                                       self._hot_master)
+        self._cold_acc = jax.tree.map(lambda a: jnp.zeros_like(a),
+                                      self._cold_acc)
+        return new_params
+
+    # -- checkpoint surface --------------------------------------------
+
+    def state_for_checkpoint(self) -> Any:
+        return self.host_opt.state_for_checkpoint()
+
+    def load_state(self, opt_state: Any) -> None:
+        self.host_opt.load_state(opt_state)
+
+    def reset_master(self, params_device: Any) -> None:
+        self.host_opt.reset_master(params_device)
+        # ALL device-side selective state is stale relative to the new master
+        # — a later flush would otherwise sync pre-reset hot columns and the
+        # old cold accumulator over it
+        self.reset_after_load()
+
+    def reset_after_load(self) -> None:
+        """Drop all device-side selective state after a checkpoint load —
+        stale hot columns/accumulators must never scatter pre-load values
+        over the restored weights.  (The caller resets the host master.)"""
+        self._indices = None
+        self._hot_master = None
+        self._hot_state = None
+        if self._cold_acc is not None:
+            self._cold_acc = jax.tree.map(jnp.zeros_like, self._cold_acc)
+        self._steps_since_flush = 0

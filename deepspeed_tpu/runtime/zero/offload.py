@@ -54,11 +54,25 @@ class OffloadedOptimizer:
 
     def __init__(self, optimizer: optax.GradientTransformation, params_device: Any,
                  cfg: OffloadOptimizerConfig, aio: Optional[AIOConfig] = None,
-                 compute_dtype=jnp.bfloat16):
+                 compute_dtype=jnp.bfloat16, param_cfg=None):
         self.optimizer = optimizer
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         self.cpu = _cpu_device()
+        # ZeRO-Infinity param tier: offload_param.device == "nvme" pages the
+        # fp32 master to NVMe between steps (reference
+        # partitioned_param_swapper.py swaps the fp16 flat param partitions;
+        # here the master IS the off-device param copy — the bf16 compute
+        # params live in the accelerator's pinned_host space, see
+        # zero/param_offload.py)
+        self._param_nvme = param_cfg is not None and \
+            getattr(param_cfg, "device_str", "none") == "nvme"
+        self._mswap = None
+        if self._param_nvme:
+            from .param_offload import ParamSwapper
+
+            mdir = (param_cfg.nvme_path or "/tmp/dstpu_nvme_swap") + "/master"
+            self._mswap = ParamSwapper(mdir, aio_cfg=aio, prefix="master")
 
         # fp32 master copy on host (reference: _create_fp32_partitions w/ CPU)
         host = jax.device_get(params_device)
@@ -83,6 +97,7 @@ class OffloadedOptimizer:
 
         # NVMe paging of the optimizer moments (ZeRO-Infinity)
         self._nvme = cfg.device_str == "nvme"
+        self._mom_reads: list = []
         if self._nvme:
             from ...nvme.aio_handle import AsyncIOHandle
 
@@ -96,6 +111,8 @@ class OffloadedOptimizer:
             self._swap_reqs: list = []
             self._swap_meta: Dict[str, Any] = {}
             self.swap_out_async()
+        if self._param_nvme:
+            self._master_out()
 
     # -- nvme paging ---------------------------------------------------
 
@@ -119,30 +136,69 @@ class OffloadedOptimizer:
         self.opt_state = None  # free host memory
         self._swapped_out = True
 
+    def _moments_read_ahead(self) -> None:
+        """Issue async NVMe reads of the moments (no blocking)."""
+        if not self._nvme or not self._swapped_out or self._mom_reads:
+            return
+        self._aio.wait_all()  # writes must land before reading the files
+        for i, (shape, dtype) in enumerate(self._swap_meta["specs"]):
+            buf = np.empty(shape, dtype)  # np.empty is always C-contiguous
+            path = os.path.join(self._swap_dir, f"opt_{i}.bin")
+            self._mom_reads.append((self._aio.pread(path, buf), buf))
+
+    def prefetch(self) -> None:
+        """Start NVMe read-ahead of the optimizer moments and the paged
+        master WHILE the device computes gradients — reference
+        ``pipelined_optimizer_swapper.py`` pipeline_read.  The engine calls
+        this right after dispatching the (async) device grad step; ``step``
+        then waits on completed reads instead of issuing them serially."""
+        self._moments_read_ahead()
+        if self._param_nvme and self.master is None:
+            self._mswap.read_ahead()
+
     def swap_in(self) -> None:
         """Read the moments back before the update (double-buffered reads)."""
         if not self._nvme or not self._swapped_out:
             return
-        self._aio.wait_all()  # ensure writes landed
+        self._moments_read_ahead()
         leaves = []
-        bufs = []
-        for i, (shape, dtype) in enumerate(self._swap_meta["specs"]):
-            buf = np.empty(shape, dtype)  # np.empty is always C-contiguous
-            path = os.path.join(self._swap_dir, f"opt_{i}.bin")
-            bufs.append((self._aio.pread(path, buf), buf))
-        for req, buf in bufs:
+        for req, buf in self._mom_reads:
             self._aio.wait(req)
             leaves.append(jax.device_put(buf, self.cpu))
+        self._mom_reads = []
         self.opt_state = jax.tree_util.tree_unflatten(
             self._swap_meta["treedef"], leaves)
         self._swapped_out = False
 
+    def drain(self) -> None:
+        """Block until all in-flight NVMe writes/reads have landed.
+
+        Public synchronization point (benchmarks/teardown) — callers must not
+        reach into the private AIO handle."""
+        if self._nvme:
+            self._aio.wait_all()
+        if self._mswap is not None:
+            self._mswap.drain()
+
     # -- the step ------------------------------------------------------
+
+    def _master_in(self) -> None:
+        """Restore the NVMe-paged fp32 master into host DRAM (no-op when the
+        param tier is off or the master is already resident)."""
+        if self._param_nvme and self.master is None:
+            self.master = jax.device_put(self._mswap.wait_in(), self.cpu)
+
+    def _master_out(self) -> None:
+        """Write-behind the master to NVMe and drop the DRAM copy."""
+        if self._param_nvme:
+            self._mswap.write_behind(self.master)
+            self.master = None
 
     def step(self, grads_device: Any, lr_scale=None) -> Any:
         """grads (device, fp32) → new device params (compute dtype).
         Transfers ride host DMA; the update itself is XLA:CPU."""
         grads_host = jax.device_put(jax.device_get(grads_device), self.cpu)
+        self._master_in()
         self.swap_in()
         if lr_scale is None:
             self.master, self.opt_state, device_params = self._update(
@@ -153,6 +209,7 @@ class OffloadedOptimizer:
                 np.float32(lr_scale))
         out = device_params
         self.swap_out_async()
+        self._master_out()
         return out
 
     # -- checkpoint surface -------------------------------------------
@@ -160,6 +217,10 @@ class OffloadedOptimizer:
     def state_for_checkpoint(self) -> Any:
         self.swap_in()
         return self.opt_state
+
+    def master_for_checkpoint(self) -> Any:
+        self._master_in()
+        return self.master
 
     def load_state(self, opt_state: Any) -> None:
         self.opt_state = jax.device_put(opt_state, self.cpu)
@@ -174,3 +235,5 @@ class OffloadedOptimizer:
         host = jax.device_get(params_device)
         self.master = jax.device_put(
             jax.tree.map(lambda x: np.asarray(x, np.float32), host), self.cpu)
+        if self._param_nvme:
+            self._master_out()

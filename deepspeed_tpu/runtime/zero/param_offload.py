@@ -1,0 +1,224 @@
+"""ZeRO-Infinity parameter offload (``offload_param``).
+
+Reference: ``runtime/swap_tensor/partitioned_param_swapper.py:37``
+(``AsyncPartitionedParameterSwapper``) — fp16 parameters live off-device
+(host DRAM, NVMe behind it) and are streamed to the accelerator only around
+their moment of use, with async handles and pinned buffers.
+
+TPU-native design (no hooks, no handle objects):
+
+* the **stacked layer parameters** (every leaf whose leading logical axis is
+  ``layers`` — the scanned stack of ``models/transformer.py``) are placed in
+  the ``pinned_host`` memory space of the *device* sharding
+  (``NamedSharding.with_memory_kind``), so HBM never holds the full stack;
+* inside the model's ``lax.scan`` the per-layer slice is ``device_put`` back
+  into device memory (``maybe_stream_in`` below).  XLA's latency-hiding
+  scheduler overlaps layer ``j+1``'s host→device DMA with layer ``j``'s
+  compute — the reference's prefetch/read-ahead pipeline, derived by the
+  compiler (same mechanism proven by ``sequence/fpdt.py`` for KV chunks);
+* the rematerialized backward **re-streams** each layer from host instead of
+  keeping it alive across the whole backward — device working set stays
+  O(layer), not O(model);
+* layer *gradients* are written back to ``pinned_host`` per scan step (the
+  jitted grad function's out-shardings), so neither params nor grads of the
+  full stack ever coexist in HBM;
+* an optional NVMe tier behind the host copy pages the fp32 master between
+  steps through the C++ AIO library (``ParamSwapper`` below; reference
+  ``partitioned_param_swapper.py`` buffer pool + aio handles).
+
+The flag is trace-time state set by the engine before it builds its jitted
+step; user ``loss_fn``s built on the model zoo pick it up automatically via
+``maybe_stream_in`` in the scan body.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+_STREAMING = False
+
+
+def set_param_streaming(on: bool) -> None:
+    """Engine switch: when True, scanned model stacks stream per-layer slices
+    host→device inside the compiled program (trace-time flag)."""
+    global _STREAMING
+    _STREAMING = bool(on)
+
+
+def param_streaming_enabled() -> bool:
+    return _STREAMING
+
+
+def host_memory_available() -> bool:
+    try:
+        dev = jax.devices()[0]
+        return any(m.kind == "pinned_host" for m in dev.addressable_memories())
+    except Exception:
+        return False
+
+
+def maybe_stream_in(layer_tree: Any) -> Any:
+    """Inside a scan body: move one layer's (already-sliced) params from the
+    host memory space into device memory.  Identity when streaming is off.
+
+    ``jax.device_put`` with a memory-kind-only transfer keeps the array's
+    mesh sharding and only flips its memory space, so this composes with any
+    tp/fsdp layout the slice already carries.
+    """
+    if not _STREAMING:
+        return layer_tree
+    try:
+        from jax._src import core as _core
+
+        dst = _core.MemorySpace.Device
+    except (ImportError, AttributeError):  # API moved: degrade to no stream
+        return layer_tree
+    return jax.tree.map(lambda x: jax.device_put(x, dst), layer_tree)
+
+
+# ---------------------------------------------------------------------------
+# engine-side sharding helpers
+# ---------------------------------------------------------------------------
+
+
+def _is_axes_leaf(x: Any) -> bool:
+    return x is None or (isinstance(x, tuple)
+                         and all(isinstance(e, (str, type(None))) for e in x))
+
+
+def offload_mask(params: Any, param_axes: Any,
+                 min_numel: int = 0) -> Any:
+    """Bool pytree: True for leaves that should live in host memory.
+
+    A leaf offloads when its logical axes start with ``layers`` (it is part
+    of a scanned stack, so per-layer streaming applies) and its element count
+    is at least ``min_numel`` (the reference's numel-denominated
+    ``stage3_param_persistence_threshold`` — tiny tensors stay device-
+    resident, ``runtime/zero/config.py param_persistence_threshold``).
+    """
+
+    def leaf_mask(axes, leaf):
+        if not (isinstance(axes, tuple) and len(axes) > 0
+                and axes[0] == "layers"):
+            return False
+        numel = int(np.prod(leaf.shape)) if hasattr(leaf, "shape") else 0
+        return numel >= min_numel
+
+    if param_axes is None:
+        return jax.tree.map(lambda _: False, params)
+    return jax.tree.map(
+        lambda axes, subtree: jax.tree.map(
+            lambda leaf: leaf_mask(axes, leaf), subtree),
+        param_axes, params, is_leaf=_is_axes_leaf)
+
+
+def apply_host_memory_kind(shardings: Any, mask: Any) -> Any:
+    """Masked leaves' NamedShardings get ``memory_kind='pinned_host'``."""
+    if not host_memory_available():
+        return shardings
+    return jax.tree.map(
+        lambda s, m: s.with_memory_kind("pinned_host") if m else s,
+        shardings, mask)
+
+
+# ---------------------------------------------------------------------------
+# NVMe tier (reference: AsyncPartitionedParameterSwapper)
+# ---------------------------------------------------------------------------
+
+
+class ParamSwapper:
+    """Pages a parameter pytree host↔NVMe through the C++ AIO library with
+    write-behind and read-ahead (reference ``partitioned_param_swapper.py``:
+    pinned buffer pool + async aio handles; here the host arrays themselves
+    are the pinned pool and the read-ahead is one whole-tree deep).
+    """
+
+    def __init__(self, swap_dir: str, aio_cfg=None, prefix: str = "param"):
+        from ...nvme.aio_handle import AsyncIOHandle
+        from ..config import AIOConfig
+
+        aio_cfg = aio_cfg or AIOConfig()
+        os.makedirs(swap_dir, exist_ok=True)
+        self._dir = swap_dir
+        self._prefix = prefix
+        self._aio = AsyncIOHandle(block_size=aio_cfg.block_size,
+                                  queue_depth=aio_cfg.queue_depth,
+                                  thread_count=aio_cfg.thread_count)
+        self._treedef = None
+        self._specs: list = []
+        self._read_reqs: Optional[list] = None
+        self._read_bufs: Optional[list] = None
+        self._write_waiter = None
+
+    def _path(self, i: int) -> str:
+        return os.path.join(self._dir, f"{self._prefix}_{i}.bin")
+
+    def write_behind(self, tree: Any) -> None:
+        """Async-write every leaf to NVMe; returns immediately.  The caller
+        may drop its host references — ``read_ahead``/``wait_in`` restore.
+
+        A background waiter releases the AIO handle's pinned buffer refs the
+        moment the writes land, so host DRAM is actually freed during the
+        inter-step window (not held hostage until the next ``wait_all``)."""
+        import threading
+
+        if self._write_waiter is not None:
+            # never allow two in-flight write sets to the same files
+            # (e.g. init's page-out followed by a prompt checkpoint load)
+            self._write_waiter.join()
+            self._write_waiter = None
+        leaves, self._treedef = jax.tree_util.tree_flatten(tree)
+        self._specs = []
+        reqs = []
+        for i, leaf in enumerate(leaves):
+            arr = np.ascontiguousarray(jax.device_get(leaf))
+            self._specs.append((arr.shape, arr.dtype))
+            reqs.append(self._aio.pwrite(self._path(i), arr))
+
+        def release():
+            for r in reqs:
+                try:
+                    self._aio.wait(r)
+                except OSError:
+                    pass  # surfaced again (loudly) by the next read
+
+        self._write_waiter = threading.Thread(target=release, daemon=True)
+        self._write_waiter.start()
+
+    def read_ahead(self) -> None:
+        """Start async reads of every leaf into fresh host buffers."""
+        if self._read_reqs is not None:
+            return
+        # writes must land before we read the files back; the background
+        # waiter owns those requests (never double-wait an AIO request)
+        waiter = getattr(self, "_write_waiter", None)
+        if waiter is not None:
+            waiter.join()
+            self._write_waiter = None
+        reqs, bufs = [], []
+        for i, (shape, dtype) in enumerate(self._specs):
+            buf = np.empty(shape, dtype)
+            reqs.append(self._aio.pread(self._path(i), buf))
+            bufs.append(buf)
+        self._read_reqs, self._read_bufs = reqs, bufs
+
+    def wait_in(self) -> Any:
+        """Block until the read-ahead completes; returns the restored tree."""
+        if self._read_reqs is None:
+            self.read_ahead()
+        for r in self._read_reqs:
+            self._aio.wait(r)
+        leaves = self._read_bufs
+        self._read_reqs = self._read_bufs = None
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def drain(self) -> None:
+        waiter = getattr(self, "_write_waiter", None)
+        if waiter is not None:
+            waiter.join()
+            self._write_waiter = None
+        self._aio.wait_all()

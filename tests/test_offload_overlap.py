@@ -1,0 +1,172 @@
+"""Offload overlap: delayed parameter update (DPU) + config-driven ZenFlow.
+
+Reference analogues: ZeRO-Offload delayed update / SuperOffload bucketed
+async step (``runtime/superoffload/superoffload_stage3.py``), ZenFlow
+config selection (``runtime/zenflow/zenflow_stage_1_and_2.py:47``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.config_utils import ConfigError
+from tests.simple_model import copy_task_batch, tiny_lm_spec
+
+BASE = {
+    "train_micro_batch_size_per_gpu": 2,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    "steps_per_print": 100,
+}
+
+
+def _cfg(**zero_extra):
+    cfg = dict(BASE)
+    cfg["zero_optimization"] = {"stage": 0, **zero_extra}
+    return cfg
+
+
+def test_delayed_update_trains_and_flushes():
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(),
+        config=_cfg(offload_optimizer={"device": "cpu",
+                                       "delayed_update": True}))
+    assert engine._delayed_update
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    first = dict(engine.train_batch(batch))["loss"]
+    for _ in range(12):
+        last = dict(engine.train_batch(batch))["loss"]
+    assert engine._pending_grads is not None  # one update in flight
+    engine.flush_delayed_update()
+    assert engine._pending_grads is None
+    assert last < first
+
+
+def test_delayed_update_applies_one_step_late():
+    """After k batches the host has applied k-1 updates; the flush applies
+    the k-th — the documented DPU staleness contract."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(param_dtype="float32", dtype="float32"),
+        config=_cfg(offload_optimizer={"device": "cpu",
+                                       "delayed_update": True}))
+    p0 = jax.device_get(engine.state.params)
+    rng = np.random.default_rng(0)
+    engine.train_batch(copy_task_batch(rng, engine.train_batch_size, 32))
+    # first batch: no update applied yet — params unchanged
+    p1 = jax.device_get(engine.state.params)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), p0, p1)
+    engine.train_batch(copy_task_batch(rng, engine.train_batch_size, 32))
+    p2 = jax.device_get(engine.state.params)  # batch-1 update now applied
+    assert any(not np.array_equal(a, b) for a, b in
+               zip(jax.tree.leaves(p1), jax.tree.leaves(p2)))
+
+
+def test_delayed_update_checkpoint_flushes(tmp_path):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(),
+        config=_cfg(offload_optimizer={"device": "cpu",
+                                       "delayed_update": True}))
+    rng = np.random.default_rng(0)
+    engine.train_batch(copy_task_batch(rng, engine.train_batch_size, 32))
+    assert engine._pending_grads is not None
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    assert engine._pending_grads is None  # save must not drop the last grads
+
+
+# ---------------------------------------------------------------------------
+# ZenFlow through the engine config
+# ---------------------------------------------------------------------------
+
+
+def test_zenflow_requires_offload():
+    with pytest.raises(ConfigError):
+        deepspeed_tpu.initialize(
+            model=tiny_lm_spec(),
+            config={**BASE, "zenflow": {"enabled": True}})
+
+
+def test_zenflow_config_driven_training():
+    cfg = _cfg(offload_optimizer={"device": "cpu"})
+    cfg["zenflow"] = {"enabled": True, "topk_ratio": 0.25,
+                      "update_interval": 4}
+    engine, *_ = deepspeed_tpu.initialize(model=tiny_lm_spec(), config=cfg)
+    zf = engine.zenflow_optimizer
+    assert zf is not None and zf.update_interval == 4
+
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    first = dict(engine.train_batch(batch))["loss"]
+    # steps 1-3: cold path stays entirely on device — zero bytes transferred
+    for _ in range(2):
+        engine.train_batch(batch)
+    assert zf.cold_bytes_transferred == 0
+    # step 4 = the interval: one amortized cold transfer + host flush
+    engine.train_batch(batch)
+    assert zf.cold_bytes_transferred > 0
+    bytes_after_flush = zf.cold_bytes_transferred
+    for _ in range(3):
+        engine.train_batch(batch)
+    assert zf.cold_bytes_transferred == bytes_after_flush  # still amortized
+    for _ in range(8):
+        last = dict(engine.train_batch(batch))["loss"]
+    assert last < first
+
+
+def test_zenflow_checkpoint_round_trip(tmp_path):
+    """Save mid-interval must flush the cold accumulator; load must drop the
+    stale device-side hot state so restored weights survive the next step."""
+    cfg = _cfg(offload_optimizer={"device": "cpu"})
+    cfg["zenflow"] = {"enabled": True, "topk_ratio": 0.25,
+                      "update_interval": 4}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(param_dtype="float32", dtype="float32"), config=cfg)
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    for _ in range(2):  # mid-interval: cold accumulator non-empty
+        engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    assert engine.zenflow_optimizer._steps_since_flush == 0  # flushed
+    saved = jax.device_get(engine.state.params)
+    for _ in range(3):
+        engine.train_batch(batch)
+    engine.load_checkpoint(str(tmp_path / "ckpt"))
+    assert engine.zenflow_optimizer._indices is None  # device state dropped
+    restored = jax.device_get(engine.state.params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+                 saved, restored)
+    # next step must not scatter stale hot columns over the restore
+    engine.train_batch(batch)
+
+
+def test_delayed_update_load_discards_pending(tmp_path):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(),
+        config=_cfg(offload_optimizer={"device": "cpu",
+                                       "delayed_update": True}))
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    engine.train_batch(batch)  # leaves a pending gradient
+    assert engine._pending_grads is not None
+    engine.load_checkpoint(str(tmp_path / "ckpt"))
+    assert engine._pending_grads is None  # stale grads must not touch restore
+
+
+def test_zenflow_compact_hot_state_is_small():
+    """Device optimizer state is O(topk_ratio): the compact moments must be
+    ~ratio × the full-matrix sizes (the offload memory win survives)."""
+    cfg = _cfg(offload_optimizer={"device": "cpu"})
+    cfg["zenflow"] = {"enabled": True, "topk_ratio": 0.125,
+                      "update_interval": 2}
+    engine, *_ = deepspeed_tpu.initialize(model=tiny_lm_spec(), config=cfg)
+    rng = np.random.default_rng(0)
+    engine.train_batch(copy_task_batch(rng, engine.train_batch_size, 32))
+    zf = engine.zenflow_optimizer
+    full = sum(x.size for x in jax.tree.leaves(engine.state.params)
+               if x.ndim >= 2)
+    compact = sum(x.size for x in jax.tree.leaves(zf._hot_master)
+                  if x.ndim >= 2)
+    assert compact <= 0.2 * full, (compact, full)

@@ -1,0 +1,204 @@
+"""ZeRO-Infinity parameter offload (``offload_param``) tests.
+
+Reference coverage analogue: ``tests/unit/runtime/zero`` NVMe/offload tests +
+``runtime/swap_tensor/partitioned_param_swapper.py`` behavior.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.zero import param_offload
+from tests.simple_model import copy_task_batch, tiny_lm_spec
+
+BASE = {
+    "train_micro_batch_size_per_gpu": 2,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    "steps_per_print": 100,
+}
+
+
+def _cfg(**zero):
+    cfg = dict(BASE)
+    # tiny fixture leaves sit under the default persistence threshold (1e5
+    # elems) — force full offload so the tests exercise the streaming path
+    zero.setdefault("stage3_param_persistence_threshold", 0)
+    cfg["zero_optimization"] = zero
+    return cfg
+
+
+def _train(engine, steps=3, seed=0):
+    rng = np.random.default_rng(seed)
+    out = None
+    for _ in range(steps):
+        batch = copy_task_batch(rng, engine.train_batch_size, 32)
+        out = engine.train_batch(batch)
+    return dict(out)
+
+
+def test_offload_mask_selects_scanned_stack():
+    spec = tiny_lm_spec(param_dtype="float32")
+    mask = param_offload.offload_mask(spec.params, spec.param_axes)
+    # every layer leaf offloads; embed/final_norm stay resident
+    assert all(jax.tree.leaves(mask["layers"]))
+    assert not any(jax.tree.leaves(mask["embed"]))
+    assert not any(jax.tree.leaves(mask["final_norm"]))
+    # persistence threshold keeps small leaves (ln scales: 2*64 = 128 elems)
+    mask_t = param_offload.offload_mask(spec.params, spec.param_axes,
+                                        min_numel=1000)
+    assert not any(jax.tree.leaves(mask_t["layers"]["ln1"]))
+    assert all(jax.tree.leaves(mask_t["layers"]["attn"]))
+
+
+def test_param_offload_params_live_in_host_memory():
+    spec = tiny_lm_spec(param_dtype="float32")
+    engine, *_ = deepspeed_tpu.initialize(
+        model=spec, config=_cfg(stage=0, offload_param={"device": "cpu"}))
+    kinds = jax.tree.map(lambda x: x.sharding.memory_kind,
+                         engine.state.params)
+    assert all(k == "pinned_host" for k in jax.tree.leaves(kinds["layers"]))
+    assert all(k != "pinned_host" for k in jax.tree.leaves(kinds["embed"]))
+    # the engine implied a host optimizer: params off-device need one
+    assert engine.offload_enabled and engine.offloaded_optimizer is not None
+
+
+def test_param_offload_matches_resident_training():
+    """Streamed-from-host training must be numerically identical to the
+    device-resident offload path (same host fp32 master update)."""
+    ref_engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(param_dtype="float32", dtype="float32"),
+        config=_cfg(stage=0, offload_optimizer={"device": "cpu"}))
+    off_engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(param_dtype="float32", dtype="float32"),
+        config=_cfg(stage=0, offload_param={"device": "cpu"}))
+
+    m_ref = _train(ref_engine, steps=3)
+    m_off = _train(off_engine, steps=3)
+    assert np.isclose(m_ref["loss"], m_off["loss"], rtol=1e-5, atol=1e-6)
+    ref_p = jax.device_get(ref_engine.state.params)
+    off_p = jax.device_get(off_engine.state.params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+                 ref_p, off_p)
+
+
+def test_param_offload_loss_decreases():
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(),
+        config=_cfg(stage=0, offload_param={"device": "cpu"}))
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    first = dict(engine.train_batch(batch))["loss"]
+    for _ in range(10):
+        last = dict(engine.train_batch(batch))["loss"]
+    assert last < first
+
+
+def test_param_offload_grad_step_consumes_host_params():
+    """The grad step runs directly on host-space params (no eager gather of
+    the stack to device first) and produces finite grads.  (Grad writeback to
+    host via out_shardings is blocked by an XLA SPMD limitation — see
+    engine._build_grad_step — so grads return in device memory.)"""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(param_dtype="float32"),
+        config=_cfg(stage=0, offload_param={"device": "cpu"}))
+    engine._assert_streaming_flag()
+    placed = engine._place_batch(
+        copy_task_batch(np.random.default_rng(0), engine.train_batch_size, 32))
+    p_kinds = jax.tree.map(lambda x: x.sharding.memory_kind,
+                           engine.state.params)
+    assert all(k == "pinned_host" for k in jax.tree.leaves(p_kinds["layers"]))
+    grads, _, _ = engine._grad_step(engine.state.params, placed,
+                                    engine.state.rng)
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in jax.tree.leaves(grads))
+
+
+def test_param_offload_device_budget():
+    """Device working set is O(layer), not O(model): the compiled grad step's
+    device-memory footprint must stay well below the full param+grad bytes.
+
+    On the CPU test backend memory_analysis does not attribute pinned_host
+    arguments separately, so the strong assertion runs on TPU only; here we
+    assert the program compiles with host-space annotations present."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(num_layers=4, hidden_size=128,
+                           intermediate_size=256, param_dtype="float32"),
+        config=_cfg(stage=0, offload_param={"device": "cpu"}))
+    engine._assert_streaming_flag()
+    placed = engine._place_batch(
+        copy_task_batch(np.random.default_rng(0), engine.train_batch_size, 32))
+    lowered = engine._grad_step.lower(engine.state.params, placed,
+                                      engine.state.rng)
+    hlo = lowered.as_text()
+    assert "pinned_host" in hlo or "S(5)" in hlo
+    if engine.accelerator.platform() != "cpu":
+        ma = lowered.compile().memory_analysis()
+        full_bytes = sum(x.size * x.dtype.itemsize
+                         for x in jax.tree.leaves(engine.state.params))
+        assert ma.argument_size_in_bytes < full_bytes
+
+
+@pytest.mark.parametrize("stage", [0, 3])
+def test_nvme_param_tier_pages_master(tmp_path, stage):
+    """offload_param device=nvme: the fp32 master pages to NVMe between steps
+    (reference AsyncPartitionedParameterSwapper role for the off-device
+    param copy)."""
+    swap = str(tmp_path / "swap")
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(param_dtype="float32", dtype="float32"),
+        config=_cfg(stage=stage,
+                    offload_param={"device": "nvme", "nvme_path": swap},
+                    offload_optimizer={"device": "cpu"}))
+    opt = engine.offloaded_optimizer
+    assert opt._param_nvme
+    assert opt.master is None  # paged out between steps
+    _train(engine, steps=2)
+    assert opt.master is None
+    files = os.listdir(os.path.join(swap, "master"))
+    assert any(f.startswith("master_") for f in files)
+    # master restores on demand (checkpoint surface) and matches params
+    master = opt.master_for_checkpoint()
+    assert master is not None
+    p = jax.device_get(engine.state.params)
+    m = jax.device_get(master)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a, np.float32), b, atol=1e-6), p, m)
+
+    # numerics match the plain cpu-offload engine
+    ref, *_ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(param_dtype="float32", dtype="float32"),
+        config=_cfg(stage=stage, offload_optimizer={"device": "cpu"}))
+    _train(ref, steps=2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+        jax.device_get(ref.state.params), p)
+
+
+def test_zero_infinity_example_config_dryruns():
+    """The shipped examples/llama3_70b_zero_infinity.json drives the full
+    ZeRO-3 × param-offload × NVMe path (model scaled down for CI)."""
+    with open(os.path.join(os.path.dirname(__file__), "..", "examples",
+                           "llama3_70b_zero_infinity.json")) as f:
+        cfg = json.load(f)
+    cfg.pop("model", None)
+    cfg["zero_optimization"]["offload_param"]["nvme_path"] = "/tmp/dstpu_ci_swap"
+    cfg["zero_optimization"]["offload_optimizer"]["nvme_path"] = "/tmp/dstpu_ci_swap"
+    cfg["train_micro_batch_size_per_gpu"] = 1
+    cfg["gradient_accumulation_steps"] = 2
+    cfg["zero_optimization"]["stage3_param_persistence_threshold"] = 0
+    spec = tiny_lm_spec("llama3-70b", num_layers=2, hidden_size=128,
+                        intermediate_size=256, num_heads=4, num_kv_heads=2,
+                        vocab_size=512, max_seq_len=64,
+                        param_dtype="float32", dtype="float32",
+                        attn_impl="xla")
+    engine, *_ = deepspeed_tpu.initialize(model=spec, config=cfg)
+    assert engine.param_offload_enabled and engine.zero_stage == 3
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, 512, size=(engine.train_batch_size, 64)).astype(np.int32)}
+    m = dict(engine.train_batch(batch))
+    assert np.isfinite(m["loss"])
