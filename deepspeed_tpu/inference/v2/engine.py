@@ -81,6 +81,29 @@ def ragged_attention_xla(q, k_cache, v_cache, block_tables, context_lens,
     return out.astype(q.dtype)
 
 
+def prefill_scatter_coords(seq_index, position_ids, chunk_start, max_seqs: int,
+                           Qp: int):
+    """Coordinates for scattering the ragged (T, H, D) q into the per-sequence
+    (max_seqs, Qp, H, D) chunk layout, plus the gather coordinates to read the
+    attention output back.
+
+    Padding tokens (seq_index == -1) MUST get POSITIVE out-of-range sentinels
+    (row == max_seqs, col == Qp): JAX normalizes negative scatter indices
+    (idx + size) *before* the ``mode="drop"`` check, so a -1 sentinel would
+    wrap onto row max_seqs-1 and collide with a real sequence's write —
+    duplicate-index ``.set`` order is nondeterministic on TPU (r3 advisor,
+    high).  Only idx >= size is genuinely dropped.
+
+    Returns (scat_row, scat_col, gather_row, gather_col); gather coords are
+    clamped in-range (padding rows read garbage that callers drop)."""
+    row = jnp.clip(seq_index, 0, max_seqs - 1)
+    qp_col = position_ids - chunk_start[row]
+    valid = seq_index >= 0
+    scat_row = jnp.where(valid, row, max_seqs)
+    scat_col = jnp.where(valid, qp_col, Qp)
+    return scat_row, scat_col, row, jnp.clip(qp_col, 0, Qp - 1)
+
+
 def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
     dt = jnp.dtype(v2.dtype)
     bs = v2.block_size
@@ -111,11 +134,10 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
         nh, nkv, hd = model_cfg.num_heads, model_cfg.kv_heads, model_cfg.head_dim
         # per-token scatter coordinates into the per-sequence chunk layout
         # (max_seqs, Qp): row = sequence, col = offset within this step's
-        # chunk. Padding tokens carry seq_index -1 → negative row → dropped.
+        # chunk (padding handled by positive OOB sentinels — see helper)
         Qp = v2.max_tokens_per_step
-        row = jnp.clip(seq_index, 0, block_tables.shape[0] - 1)
-        qp_col = position_ids - chunk_start[row]
-        scat_row = jnp.where(seq_index >= 0, row, -1)
+        scat_row, scat_col, gath_row, gath_col = prefill_scatter_coords(
+            seq_index, position_ids, chunk_start, block_tables.shape[0], Qp)
 
         def layer_body(x, inp):
             lp, k_cache, v_cache = inp
@@ -138,11 +160,12 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
             from ...ops.pallas.paged_attention import paged_prefill_attention
 
             q_seq = jnp.zeros((block_tables.shape[0], Qp, nh, hd), q.dtype)
-            q_seq = q_seq.at[scat_row, qp_col].set(q, mode="drop")
+            q_seq = q_seq.at[scat_row, scat_col].set(q, mode="drop")
             o_seq = paged_prefill_attention(q_seq, k_cache, v_cache,
                                             block_tables, chunk_start,
                                             chunk_len)
-            o = o_seq[row, qp_col]  # (T, H, D); padding rows read garbage
+            # padding rows read in-range garbage (clamped col), dropped later
+            o = o_seq[gath_row, gath_col]  # (T, H, D)
             attn_out = tfm._lin(o.reshape(T, nh * hd), lp["attn"], "wo", "bo")
             m_src = x if model_cfg.parallel_residual else x + attn_out
             m_in = tfm._norm(m_src, lp["ln2"], model_cfg.norm,

@@ -108,8 +108,11 @@ def _decode_kernel(block_tables_ref, context_lens_ref,  # scalar prefetch
 
         m_cur = jnp.max(scores, axis=1, keepdims=True)  # (H, 1)
         m_new = jnp.maximum(m, m_cur)
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new)  # invalid cols → 0
+        # fully-masked rows keep m_new == -inf; exp(-inf - -inf) would be
+        # NaN, so rescale against a zeroed stand-in (their p is 0 anyway)
+        m_safe = jnp.where(m_new == -jnp.inf, 0.0, m_new)
+        alpha = jnp.exp(m - m_safe)
+        p = jnp.exp(scores - m_safe)  # invalid cols → 0
         l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
@@ -148,8 +151,8 @@ def paged_decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         grid=(S,),
         in_specs=[
             pl.BlockSpec((1, H, D), lambda s, *_: (s, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda s, *_: (s, 0, 0)),
         scratch_shapes=[
@@ -269,8 +272,12 @@ def _prefill_kernel(block_tables_ref, chunk_start_ref, chunk_len_ref,  # SMEM
 
         m_cur = jnp.max(scores, axis=1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new)
+        # padding q rows inside an active tile (tile_lo < qlen ≤ tile_lo+row)
+        # have every column masked → m_new stays -inf and exp(-inf - -inf)
+        # is NaN; rescaling against 0 instead makes those rows emit zeros
+        m_safe = jnp.where(m_new == -jnp.inf, 0.0, m_new)
+        alpha = jnp.exp(m - m_safe)
+        p = jnp.exp(scores - m_safe)
         l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
@@ -319,8 +326,8 @@ def paged_prefill_attention(q: jax.Array, k_cache: jax.Array,
         grid=(S, Qp // tq),
         in_specs=[
             pl.BlockSpec((1, tq, H, D), lambda s, t, *_: (s, t, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
         ],
         out_specs=pl.BlockSpec((1, tq, H, D), lambda s, t, *_: (s, t, 0, 0)),
         scratch_shapes=[

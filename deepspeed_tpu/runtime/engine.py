@@ -274,6 +274,7 @@ class TrainingEngine:
         self._delayed_update = False
         self._pending_grads = None
         self._pending_lr_scale = None
+        self._pending_lr = None
         self.zenflow_optimizer = None
         if config.zenflow.enabled and not self.offload_enabled:
             raise ConfigError(
@@ -656,7 +657,9 @@ class TrainingEngine:
             # batch N+1.  Step time ≈ max(device, host) — the SuperOffload /
             # pipelined-swapper dataflow (superoffload_stage3.py:1,
             # pipelined_optimizer_swapper.py:52).
+            applied_lr = None
             if self._pending_grads is not None:
+                applied_lr = self._pending_lr
                 new_params = self.offloaded_optimizer.step(
                     self._pending_grads, lr_scale=self._pending_lr_scale)
                 new_params = jax.tree.map(
@@ -666,6 +669,7 @@ class TrainingEngine:
                 new_params = self.state.params
             self._pending_grads = grads
             self._pending_lr_scale = lr_scale
+            self._pending_lr = lr
         else:
             new_params = self.offloaded_optimizer.step(grads, lr_scale=lr_scale)
             new_params = jax.tree.map(
@@ -675,6 +679,13 @@ class TrainingEngine:
             self.state, step=self.state.step + 1, params=new_params, rng=rng)
         out = {k: float(v) for k, v in metrics.items()}
         out["lr"] = lr
+        if (self._delayed_update and self.zenflow_optimizer is None
+                and applied_lr is not None):
+            # metrics (lr/loss/grad_norm) describe the CURRENT batch, but the
+            # parameters were just updated with the PREVIOUS batch's pending
+            # grads — surface the lr that update actually deserved so logs
+            # aren't off by one (r3 advisor); absent on step 1 (no update)
+            out["applied_lr"] = applied_lr
         return out
 
     def flush_delayed_update(self) -> None:
@@ -687,6 +698,7 @@ class TrainingEngine:
             self._pending_grads, lr_scale=self._pending_lr_scale)
         self._pending_grads = None
         self._pending_lr_scale = None
+        self._pending_lr = None
         new_params = jax.tree.map(
             lambda x, s: jax.device_put(x, s), new_params,
             self.param_shardings)

@@ -110,6 +110,11 @@ class ZenFlowOptimizer:
         self._cold_acc: Optional[Any] = None  # device-resident accumulator
         self.cold_bytes_transferred = 0  # flush-only D2H accounting
         self._steps_since_flush = 0
+        # variable-batch LR: each hot update used its own per-step lr_scale;
+        # the amortized cold update must use the interval's MEAN scale, not
+        # whichever step happened to trigger the flush
+        self._lr_scale_acc = 0.0
+        self._any_lr_scale = False
 
         def select(grads):
             def one(g):
@@ -225,6 +230,8 @@ class ZenFlowOptimizer:
                            self._hot_state, self._cold_acc,
                            jnp.float32(1.0 if lr_scale is None else lr_scale))
         self._steps_since_flush += 1
+        self._lr_scale_acc += 1.0 if lr_scale is None else float(lr_scale)
+        self._any_lr_scale |= lr_scale is not None
         if self._step % self.update_interval == 0:
             params = self._flush(params, lr_scale)
         return params
@@ -238,9 +245,16 @@ class ZenFlowOptimizer:
 
     def _flush(self, params: Any, lr_scale=None) -> Any:
         """Amortized cold update: ONE D2H of the accumulated cold mean, host
-        optimizer step, hot columns re-applied on top."""
-        scale = 1.0 / max(1, self._steps_since_flush)
+        optimizer step, hot columns re-applied on top.  ``lr_scale`` is the
+        triggering step's scale; the applied scale is the interval's mean
+        (each accumulated cold grad "deserved" its own step's scale)."""
+        n = max(1, self._steps_since_flush)
+        scale = 1.0 / n
+        if self._any_lr_scale:
+            lr_scale = self._lr_scale_acc / n
         self._steps_since_flush = 0
+        self._lr_scale_acc = 0.0
+        self._any_lr_scale = False
         cold_mean = jax.tree.map(lambda a: a * scale, self._cold_acc)
         self._sync_hot_into_host_master()
         cold_host = jax.device_get(cold_mean)  # the single amortized transfer
@@ -281,3 +295,5 @@ class ZenFlowOptimizer:
         if self._cold_acc is not None:
             self._cold_acc = jax.tree.map(jnp.zeros_like, self._cold_acc)
         self._steps_since_flush = 0
+        self._lr_scale_acc = 0.0
+        self._any_lr_scale = False

@@ -71,13 +71,36 @@ def maybe_stream_in(layer_tree: Any) -> Any:
     """
     if not _STREAMING:
         return layer_tree
+    dst = _device_memory_space()
+    if dst is None:  # API moved: degrade to no stream (params stay on host)
+        return layer_tree
+    return jax.tree.map(lambda x: jax.device_put(x, dst), layer_tree)
+
+
+def _device_memory_space():
+    """The destination for a memory-kind-only transfer, preferring the public
+    ``jax.memory.Space`` API; falls back to the older private location.  When
+    neither exists, ``offload_param`` silently becomes "params live on host"
+    — a real HBM/perf behavior change — so warn once instead of hiding it."""
+    try:
+        from jax.memory import Space  # public since jax 0.9
+
+        return Space.Device
+    except (ImportError, AttributeError):
+        pass
     try:
         from jax._src import core as _core
 
-        dst = _core.MemorySpace.Device
-    except (ImportError, AttributeError):  # API moved: degrade to no stream
-        return layer_tree
-    return jax.tree.map(lambda x: jax.device_put(x, dst), layer_tree)
+        return _core.MemorySpace.Device
+    except (ImportError, AttributeError):
+        from ...utils.logging import warning_once
+
+        warning_once(
+            "offload_param: no memory-space transfer API in this jax "
+            "(jax.memory.Space / jax._src.core.MemorySpace both absent) "
+            "— layer streaming DISABLED; offloaded params will be read "
+            "directly from host memory every use")
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -93,6 +93,71 @@ def test_v2_concurrent_requests(devices, tiny_model):
                                       err_msg=f"uid {uid} prompt {p}")
 
 
+def test_prefill_scatter_drops_padding():
+    """Regression (r3 advisor, high): padding tokens carry seq_index=-1; a
+    negative scatter row is normalized (idx+size) before the drop check, so
+    -1 wrapped onto row max_seqs-1 and collided with the LAST sequence's
+    prefill q whenever the batch held max_seqs sequences (duplicate-index
+    .set order is nondeterministic on TPU — a behavioral test can pass on
+    CPU where the real write happens to win).  Assert the index invariant
+    directly: padding must get POSITIVE out-of-range sentinels, and a
+    poisoned scatter through them must leave every real row untouched."""
+    from deepspeed_tpu.inference.v2.engine import prefill_scatter_coords
+
+    max_seqs, Qp = 4, 8
+    # 4 real tokens (rows 0..3, row 0 prefilling from position 0) + 2 padding
+    seq_index = jnp.array([0, 1, 2, 3, -1, -1], jnp.int32)
+    position_ids = jnp.array([0, 5, 2, 0, 0, 0], jnp.int32)
+    chunk_start = jnp.array([0, 5, 2, 0], jnp.int32)
+    scat_row, scat_col, gath_row, gath_col = prefill_scatter_coords(
+        seq_index, position_ids, chunk_start, max_seqs, Qp)
+    # padding sentinels are OUT OF RANGE HIGH — never -1 (which wraps) and
+    # never a real row
+    np.testing.assert_array_equal(scat_row[4:], [max_seqs, max_seqs])
+    np.testing.assert_array_equal(scat_col[4:], [Qp, Qp])
+    np.testing.assert_array_equal(scat_row[:4], [0, 1, 2, 3])
+    np.testing.assert_array_equal(scat_col[:4], [0, 0, 0, 0])
+    # gather coords stay in range for all tokens
+    assert int(gath_row.max()) < max_seqs and int(gath_col.max()) < Qp
+    # end-to-end scatter semantics: poison the padding q with NaN; with the
+    # sentinel coords mode="drop" must drop it — base array stays finite
+    q = jnp.ones((6, 2), jnp.float32).at[4:].set(jnp.nan)
+    q_seq = jnp.zeros((max_seqs, Qp, 2), jnp.float32)
+    q_seq = q_seq.at[scat_row, scat_col].set(q, mode="drop")
+    assert np.isfinite(np.asarray(q_seq)).all(), \
+        "padding write was not dropped"
+    # and document the JAX behavior the fix guards against: a -1 row index
+    # is NOT dropped — it wraps onto the last row
+    wrapped = jnp.zeros((max_seqs, Qp, 2), jnp.float32).at[
+        jnp.array([-1]), jnp.array([0])].set(
+        jnp.full((1, 2), jnp.nan), mode="drop")
+    assert np.isnan(np.asarray(wrapped[max_seqs - 1, 0])).all(), \
+        "jax scatter semantics changed: -1 no longer wraps (fix may be moot)"
+
+
+def test_v2_full_batch_padding_exact(devices, tiny_model):
+    """Full batch (max_seqs sequences) + padding tokens: every sequence must
+    match its uncached continuation exactly (companion behavioral check to
+    test_prefill_scatter_drops_padding)."""
+    cfg, params = tiny_model
+    eng = InferenceEngineV2(cfg, params, V2Config(
+        max_tokens_per_step=32, max_seqs=4, block_size=8, num_blocks=64,
+        max_blocks_per_seq=8, dtype="float32"))
+    # 4 sequences = max_seqs; 3+4+5+2 = 14 tokens < 32 budget → 18 padding
+    # tokens in the prefill step; sequence row 0 prefills from position 0
+    prompts = [[1, 2, 3], [9, 8, 7, 6], [11, 12, 13, 14, 15], [21, 22]]
+    uids = [eng.put(p, max_new_tokens=4) for p in prompts]
+    results = eng.generate_all()
+    for p, uid in zip(prompts, uids):
+        seq = np.array([p], np.int32)
+        for _ in range(4):
+            logits = tfm.forward(params, seq, cfg)
+            nxt = np.asarray(logits[:, -1].argmax(-1)).astype(np.int32)
+            seq = np.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(results[uid], seq[0].tolist(),
+                                      err_msg=f"uid {uid} prompt {p}")
+
+
 def test_v2_blocks_recycled(devices, tiny_model):
     cfg, params = tiny_model
     eng = InferenceEngineV2(cfg, params, V2Config(
