@@ -6,6 +6,21 @@ The metric is model FLOPs utilisation (MFU) of a bf16 ZeRO training step of a
 LLaMA-architecture model sized for the available chip — the single-chip proxy
 for BASELINE.json's "tokens/sec/chip at 8B ZeRO-3 ≥45% MFU on v5e-256" target.
 ``vs_baseline`` = achieved_MFU / 0.45 (the reference north-star MFU).
+
+r4 hardening (VERDICT r3 "what's weak" #1):
+* the TPU probe FAILS FAST — 60s subprocess timeout, 3 attempts ≈ 3.5 min
+  worst case instead of r3's 20 min;
+* a persistent JAX compilation cache (``.jax_cache/``) survives across runs,
+  so a short TPU window still yields a measurement (the ~0.6B-model compile
+  is the long pole; cached it is seconds);
+* one FINAL probe retry fires after the CPU fallback work, in case the
+  tunnel came up while the fallback ran;
+* when no chip is reachable the bench emits a machine-checkable
+  compile-evidence pack (``BENCH_EVIDENCE.json``: HLO collective census +
+  fusion density of the sharded flagship step — see
+  ``deepspeed_tpu/profiling/compile_evidence.py``) and failure telemetry in
+  ``extra`` (attempts, seconds burned), so the round records *why* there is
+  no hardware number in minutes, not hours.
 """
 
 from __future__ import annotations
@@ -18,26 +33,63 @@ import time
 
 import numpy as np
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_CACHE_DIR = os.path.join(_REPO, ".jax_cache")
 
-def _tpu_probe(timeout_s: float = 600.0, attempts: int = 2) -> bool:
+
+def _cache_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_COMPILATION_CACHE_DIR"] = _CACHE_DIR
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
+    return env
+
+
+def _enable_compile_cache() -> None:
+    """In-process variant of :func:`_cache_env` (call after ``import jax``)."""
+    import jax
+
+    try:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # cache is an optimization, never a hard dep
+        sys.stderr.write(f"bench: compile cache unavailable: {e}\n")
+
+
+def _tpu_probe(timeout_s: float = 60.0, attempts: int = 3,
+               telemetry: dict | None = None) -> bool:
     """Probe accelerator availability in a SUBPROCESS with a hard timeout.
 
     Round-2/3 lesson: the TPU plugin can *hang* during init (tunnel down), and
     a hang inside this process is unrecoverable — no exception ever fires.  A
-    subprocess probe turns the hang into a catchable timeout; on failure we
-    pin this process to the host CPU so the bench still emits a record.
-    """
+    subprocess probe turns the hang into a catchable timeout.  Fail-fast: 60s
+    per attempt (a healthy tunnel answers in ~5s; r3's 600s × 2 burned 20
+    minutes of the bench window learning nothing)."""
     code = "import jax; jax.devices(); print(jax.default_backend())"
+    t0 = time.monotonic()
+
+    def account(ran: int) -> None:
+        # telemetry ACCUMULATES across calls (probe → fallback → final retry)
+        # so the record shows the whole story, not just the last call
+        if telemetry is not None:
+            telemetry["probe_attempts"] = telemetry.get("probe_attempts", 0) + ran
+            telemetry["probe_seconds"] = round(
+                telemetry.get("probe_seconds", 0.0) + time.monotonic() - t0, 1)
+
     for attempt in range(attempts):
         try:
             r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
-                               capture_output=True, text=True)
+                               capture_output=True, text=True, env=_cache_env())
             if r.returncode == 0 and r.stdout.strip() not in ("", "cpu"):
+                account(attempt + 1)
                 return True
             if r.returncode == 0:
                 # clean 'cpu' answer is deterministic — retrying cannot
-                # produce a TPU; don't burn 15s + another probe
+                # produce a TPU
                 sys.stderr.write("bench: no accelerator (cpu backend)\n")
+                account(attempt + 1)
                 return False
             sys.stderr.write(f"bench: tpu probe attempt {attempt + 1} failed "
                              f"(rc={r.returncode})\n")
@@ -46,11 +98,45 @@ def _tpu_probe(timeout_s: float = 600.0, attempts: int = 2) -> bool:
                              f">{timeout_s:.0f}s\n")
         if attempt < attempts - 1:
             time.sleep(15.0)
+    account(attempts)
     return False
 
 
+def _write_evidence_pack(telemetry: dict) -> None:
+    """No chip: compile-level evidence (HLO collective census + fusion
+    density) in a subprocess pinned to the virtual-mesh CPU backend."""
+    try:
+        env = _cache_env()
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.profiling.compile_evidence"],
+            timeout=900, capture_output=True, text=True, env=env, cwd=_REPO)
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
+        evidence = json.loads(line)
+        with open(os.path.join(_REPO, "BENCH_EVIDENCE.json"), "w") as f:
+            json.dump(evidence, f, indent=1)
+        ms = evidence.get("multichip_step", {})
+        telemetry["evidence"] = {
+            "file": "BENCH_EVIDENCE.json",
+            "collectives": ms.get("collectives"),
+            "hlo_fusions": evidence.get("fusion", {}).get("hlo_fusions"),
+        }
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        telemetry["evidence"] = {"error": f"{type(e).__name__}: {e}"}
+
+
 def main() -> None:
-    if not _tpu_probe():
+    telemetry: dict = {}
+    on_tpu_probe = _tpu_probe(telemetry=telemetry)
+    if not on_tpu_probe:
+        # produce the fallback evidence FIRST (it takes a few minutes), then
+        # give the tunnel one last chance before settling for the CPU record
+        _write_evidence_pack(telemetry)
+        if _tpu_probe(timeout_s=60.0, attempts=1, telemetry=telemetry):
+            on_tpu_probe = True
+            sys.stderr.write("bench: tunnel came up during fallback — "
+                             "running the real benchmark\n")
+    if not on_tpu_probe:
         # No live TPU: force the CPU smoke path rather than hanging forever.
         os.environ["DSTPU_ACCELERATOR"] = "cpu"
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -58,6 +144,8 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
     import jax
+
+    _enable_compile_cache()
 
     import deepspeed_tpu
     from deepspeed_tpu.accelerator import get_accelerator
@@ -130,18 +218,20 @@ def main() -> None:
     peak = accel.peak_tflops("bfloat16") * len(jax.devices())
     mfu = achieved_tflops / peak if peak else 0.0
 
+    extra = {
+        "tokens_per_sec_per_chip": round(tokens_per_sec / len(jax.devices()), 1),
+        "achieved_tflops": round(achieved_tflops, 2),
+        "step_time_s": round(dt, 4),
+        "model_params_m": round(cfg.num_params() / 1e6, 1),
+        "device": accel.device_kind(),
+    }
+    extra.update(telemetry)
     print(json.dumps({
         "metric": "train_step_mfu_0p6b_llama_1chip" if on_tpu else "train_step_mfu_smoke_cpu",
         "value": round(mfu, 4),
         "unit": "mfu_fraction",
         "vs_baseline": round(mfu / 0.45, 4),
-        "extra": {
-            "tokens_per_sec_per_chip": round(tokens_per_sec / len(jax.devices()), 1),
-            "achieved_tflops": round(achieved_tflops, 2),
-            "step_time_s": round(dt, 4),
-            "model_params_m": round(cfg.num_params() / 1e6, 1),
-            "device": accel.device_kind(),
-        },
+        "extra": extra,
     }))
 
 

@@ -1,0 +1,140 @@
+"""Compile-level performance evidence pack.
+
+When the benchmark cannot reach a real chip (the axon tunnel hangs — r2/r3),
+perf claims still need something auditable.  This module compiles the
+flagship training step over a virtual multi-device mesh and reports, from
+the OPTIMIZED HLO, the facts the perf story rests on:
+
+* which collectives XLA inserted for the ZeRO-3 × TP sharding (all-gather
+  for fsdp param gathers, reduce-scatter for grad partitioning, all-reduce
+  for TP contractions) — the fetch-coordinator / partitioner "schedule";
+* how many of those collectives are ASYNC pairs (``*-start``/``*-done``) —
+  evidence the latency-hiding scheduler can overlap them with compute
+  (the reference's overlap_comm / prefetch machinery, done by the compiler);
+* fusion density (jaxpr ops → HLO fusions) of the single-device step — the
+  DeepCompile-role evidence that the step lowers to one fused program.
+
+Run ``python -m deepspeed_tpu.profiling.compile_evidence`` (the bench's CPU
+fallback does) — prints one JSON object.  Pure compile analysis: no timing,
+so it is deterministic and runs anywhere.
+
+Reference for the role: ``deepspeed/compile/`` (graph passes inserting
+gather/release/prefetch) and ``runtime/zero/partitioned_param_coordinator.py``
+— here the same schedule is derived by GSPMD + the latency-hiding scheduler,
+and this report is how we audit it.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import re
+from typing import Any, Dict
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+
+def hlo_collective_census(hlo_text: str) -> Dict[str, Any]:
+    """Count collective ops in HLO text.  Async pairs (``*-start``/``*-done``)
+    count ONCE (by their start) — both into the per-op census and into the
+    separate async tally, since an async collective is still a collective."""
+    counts: Dict[str, int] = collections.Counter()
+    async_pairs: Dict[str, int] = collections.Counter()
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        for coll in _COLLECTIVES:
+            if re.search(rf"\b{coll}(\.\d+)?\(", line):  # sync form
+                counts[coll] += 1
+            if re.search(rf"\b{coll}-start(\.\d+)?\(", line):  # async form
+                counts[coll] += 1
+                async_pairs[coll] += 1
+    return {"collectives": dict(counts), "async_started": dict(async_pairs),
+            "total": int(sum(counts.values())),
+            "total_async": int(sum(async_pairs.values()))}
+
+
+def multichip_step_evidence(n_devices: int = 8) -> Dict[str, Any]:
+    """Compile the flagship-architecture training step under
+    {dp,fsdp,tp} sharding on a virtual mesh; census the optimized HLO."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import transformer as tfm
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    cfg = tfm.get_config(
+        "llama3-8b", num_layers=2, hidden_size=256, intermediate_size=704,
+        num_heads=8, num_kv_heads=4, vocab_size=1024, max_seq_len=256,
+        param_dtype="bfloat16")
+    params = tfm.init_params(__import__("jax").random.PRNGKey(0), cfg)
+
+    def loss_fn(p, batch, rng):
+        return tfm.loss_fn(p, batch, cfg)
+
+    spec = ModelSpec(loss_fn=loss_fn, params=params,
+                     param_axes=tfm.param_axes(cfg))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=spec,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 3},
+            "mesh": {"tensor_parallel_size": 2, "fsdp_size": 2,
+                     "data_parallel_size": n_devices // 4},
+            "steps_per_print": 10_000,
+        })
+    batch = {"input_ids": np.zeros((engine.train_batch_size, 128), np.int32)}
+    placed = engine._place_batch(batch)
+    compiled = engine._train_step.lower(engine.state, placed).compile()
+    hlo = compiled.as_text()
+    census = hlo_collective_census(hlo)
+    census["mesh"] = {"dp": n_devices // 4, "fsdp": 2, "tp": 2}
+    census["hlo_instructions"] = hlo.count("=")
+    try:
+        cost = compiled.cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        census["flops"] = float(cost.get("flops", -1.0))
+        census["bytes_accessed"] = float(cost.get("bytes accessed", -1.0))
+    except Exception:
+        pass
+    return census
+
+
+def fusion_evidence() -> Dict[str, Any]:
+    """Single-device flagship fusion density (DeepCompile-role evidence)."""
+    from .overlap_benchmark import default_fusion_subject
+
+    return default_fusion_subject()
+
+
+def build_evidence(n_devices: int = 8) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"kind": "compile_evidence", "n_devices": n_devices}
+    try:
+        out["multichip_step"] = multichip_step_evidence(n_devices)
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        out["multichip_step"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        out["fusion"] = fusion_evidence()
+    except Exception as e:  # noqa: BLE001
+        out["fusion"] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def main() -> int:
+    import os
+
+    n = int(os.environ.get("DSTPU_EVIDENCE_DEVICES", "8"))
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(build_evidence(n)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

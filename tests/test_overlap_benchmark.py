@@ -47,3 +47,37 @@ def test_train_step_fusion_evidence():
     # fusions present (not one kernel per op)
     assert rep["jaxpr_eqns"] > 50
     assert rep["hlo_fusions"] >= 1
+
+
+def test_hlo_collective_census_counts_async_forms():
+    """Async pairs (*-start/*-done) are still collectives: they must count
+    once (by their start) into the census AND into the async tally —
+    otherwise the evidence pack underreports exactly when overlap works."""
+    from deepspeed_tpu.profiling.compile_evidence import hlo_collective_census
+
+    hlo = "\n".join([
+        "x = bf16[4] all-gather-start(a)",
+        "y = bf16[4] all-gather-done(x)",
+        "z = f32[2] all-reduce(b)",
+        "w = f32[2] all-reduce.1(c)",
+        "q = f32[2] reduce-scatter-start(d)",
+        "r = f32[2] reduce-scatter-done(q)",
+    ])
+    c = hlo_collective_census(hlo)
+    assert c["collectives"] == {"all-gather": 1, "all-reduce": 2,
+                                "reduce-scatter": 1}
+    assert c["async_started"] == {"all-gather": 1, "reduce-scatter": 1}
+    assert c["total"] == 4 and c["total_async"] == 2
+
+
+def test_multichip_compile_evidence(devices):
+    """The sharded flagship step's HLO must contain the collectives the
+    ZeRO-3 x TP design implies (gathers for fsdp params, reductions for
+    grads/TP contractions)."""
+    from deepspeed_tpu.profiling.compile_evidence import multichip_step_evidence
+
+    ev = multichip_step_evidence(8)
+    assert ev["total"] > 0, ev
+    assert "all-gather" in ev["collectives"], ev
+    assert ("all-reduce" in ev["collectives"]
+            or "reduce-scatter" in ev["collectives"]), ev
