@@ -111,8 +111,11 @@ def _write_evidence_pack(telemetry: dict) -> None:
         r = subprocess.run(
             [sys.executable, "-m", "deepspeed_tpu.profiling.compile_evidence"],
             timeout=900, capture_output=True, text=True, env=env, cwd=_REPO)
-        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
-        evidence = json.loads(line)
+        if r.returncode != 0 or not r.stdout.strip():
+            raise RuntimeError(
+                f"evidence subprocess rc={r.returncode}: "
+                f"{r.stderr.strip().splitlines()[-1] if r.stderr.strip() else 'no output'}")
+        evidence = json.loads(r.stdout.strip().splitlines()[-1])
         with open(os.path.join(_REPO, "BENCH_EVIDENCE.json"), "w") as f:
             json.dump(evidence, f, indent=1)
         ms = evidence.get("multichip_step", {})

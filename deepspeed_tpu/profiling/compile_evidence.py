@@ -90,7 +90,10 @@ def multichip_step_evidence(n_devices: int = 8) -> Dict[str, Any]:
     hlo = compiled.as_text()
     census = hlo_collective_census(hlo)
     census["mesh"] = {"dp": n_devices // 4, "fsdp": 2, "tp": 2}
-    census["hlo_instructions"] = hlo.count("=")
+    # one instruction per "%name = ..." / "ROOT %name = ..." line (a plain
+    # '=' count would also hit attribute syntax like channel_id=1)
+    census["hlo_instructions"] = len(re.findall(
+        r"^\s*(?:ROOT\s+)?[%\w.\-]+\s*=\s", hlo, re.MULTILINE))
     try:
         cost = compiled.cost_analysis()
         cost = cost[0] if isinstance(cost, (list, tuple)) else cost
