@@ -178,6 +178,34 @@ int64_t do_pwrite(const char* path, const void* buf, int64_t count, int64_t offs
     return done;
 }
 
+// pwrite loop on an already-open fd (FastPersist path: the file is opened
+// once and many chunk writes land at offsets concurrently — per-request
+// open/close costs a dentry lookup + fd churn per chunk).
+int64_t do_fd_pwrite(int fd, const void* buf, int64_t count, int64_t offset,
+                     size_t block_size) {
+    int64_t done = 0;
+    while (done < count) {
+        size_t chunk = std::min<int64_t>(count - done, (int64_t)block_size);
+        ssize_t n = pwrite(fd, (const char*)buf + done, chunk, offset + done);
+        if (n < 0) { return -errno; }
+        done += n;
+    }
+    return done;
+}
+
+int64_t do_fd_pread(int fd, void* buf, int64_t count, int64_t offset,
+                    size_t block_size) {
+    int64_t done = 0;
+    while (done < count) {
+        size_t chunk = std::min<int64_t>(count - done, (int64_t)block_size);
+        ssize_t n = pread(fd, (char*)buf + done, chunk, offset + done);
+        if (n < 0) { return -errno; }
+        if (n == 0) break;
+        done += n;
+    }
+    return done;
+}
+
 }  // namespace
 
 extern "C" {
@@ -229,6 +257,63 @@ int64_t aio_wait(void* h, int64_t request_id) {
 }
 
 int64_t aio_wait_all(void* h) { return static_cast<Handle*>(h)->wait_all(); }
+
+// ---- fd-based writer API (FastPersist: open once, write chunks at offsets
+// from the thread pool, fsync+truncate once) -------------------------------
+
+// Open for writing; returns fd (>=0) or -errno.  use_direct=1 requests
+// O_DIRECT and FAILS (no silent fallback) so the caller can choose the
+// buffered strategy explicitly; truncate=1 starts the file empty.
+int64_t aio_file_open_write(const char* path, int use_direct, int truncate) {
+    int flags = O_WRONLY | O_CREAT | (truncate ? O_TRUNC : 0);
+#ifdef O_DIRECT
+    if (use_direct) flags |= O_DIRECT;
+#else
+    if (use_direct) return -95;  // EOPNOTSUPP
+#endif
+    int fd = open(path, flags, 0644);
+    return fd < 0 ? -errno : fd;
+}
+
+int64_t aio_file_open_read(const char* path, int use_direct) {
+    int flags = O_RDONLY;
+#ifdef O_DIRECT
+    if (use_direct) flags |= O_DIRECT;
+#endif
+    int fd = open(path, flags);
+    return fd < 0 ? -errno : fd;
+}
+
+// fsync (if do_sync) and close; truncate_to >= 0 first trims O_DIRECT
+// padding back to the logical size (requires reopening without O_DIRECT on
+// some filesystems — ftruncate on the O_DIRECT fd is fine on Linux).
+int64_t aio_file_close(int64_t fd, int do_sync, int64_t truncate_to) {
+    int64_t rc = 0;
+    if (truncate_to >= 0 && ftruncate((int)fd, (off_t)truncate_to) != 0)
+        rc = -errno;
+    if (do_sync && fsync((int)fd) != 0) rc = -errno;
+    if (close((int)fd) != 0 && rc == 0) rc = -errno;
+    return rc;
+}
+
+// Async chunk write on an open fd; returns request id.
+int64_t aio_fd_pwrite(void* h, int64_t fd, const void* buf, int64_t count,
+                      int64_t offset) {
+    auto* handle = static_cast<Handle*>(h);
+    size_t bs = handle->block_size;
+    return handle->submit([fd, buf, count, offset, bs] {
+        return do_fd_pwrite((int)fd, buf, count, offset, bs);
+    });
+}
+
+int64_t aio_fd_pread(void* h, int64_t fd, void* buf, int64_t count,
+                     int64_t offset) {
+    auto* handle = static_cast<Handle*>(h);
+    size_t bs = handle->block_size;
+    return handle->submit([fd, buf, count, offset, bs] {
+        return do_fd_pread((int)fd, buf, count, offset, bs);
+    });
+}
 
 // Aligned buffer helpers (pinned-buffer analogue: page-aligned host memory).
 void* aio_alloc_aligned(int64_t size, int64_t alignment) {

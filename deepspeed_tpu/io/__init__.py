@@ -1,0 +1,7 @@
+"""Fast checkpoint I/O (reference: ``deepspeed/io/`` FastPersist writers)."""
+
+from .fast_writer import (FastFileWriter, build_safetensors_header,
+                          get_fast_writer, probe_o_direct)
+
+__all__ = ["FastFileWriter", "build_safetensors_header", "get_fast_writer",
+           "probe_o_direct"]

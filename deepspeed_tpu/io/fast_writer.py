@@ -1,0 +1,349 @@
+"""FastPersist checkpoint writer.
+
+Capability analogue of the reference's FastPersist stack
+(``deepspeed/io/fast_file_writer.py`` double-buffered O_DIRECT writes,
+``runtime/checkpoint_engine/fast_checkpoint_engine.py``; claimed >20x over
+``torch.save`` in ``blogs/deepnvme/06-2025``): checkpoint bytes go to disk
+through the C++ AIO thread pool (``csrc/aio/ds_aio.cpp``) instead of a
+single-threaded Python write loop.
+
+Design (TPU-native twist — the host snapshot is already a set of numpy
+buffers, so serialization is addressable memory, not a pickle stream):
+
+* the output file is a **valid safetensors file** — header built here,
+  tensor bytes placed at their exact offsets — so the existing native
+  checkpoint loader reads FastPersist checkpoints unchanged;
+* **buffered mode (default)**: zero-copy — each tensor's own host buffer is
+  submitted directly to the AIO pool as chunked ``pwrite``s at its file
+  offset on ONE shared fd per file (the r3 csrc/aio gap: per-request
+  open/close).  Large tensors are split into segments so every pool thread
+  works even on a single-tensor checkpoint; ``save_trees`` keeps SEVERAL
+  files' chunks in flight together (measured 1.25x on durable writes —
+  IO_BENCH.md);
+* **O_DIRECT mode**: double-buffered — the logical byte stream is staged
+  into page-aligned bounce buffers while the previous buffer's write is in
+  flight, then the file is ftruncated back to the logical size (O_DIRECT
+  writes whole aligned blocks).  This is the reference's pinned-buffer
+  pipeline;
+* ``save_tree(s)`` starts ``copy_to_host_async`` on every jax leaf before
+  materializing any of them, so D2H transfer overlaps serialization — the
+  role the reference's double buffering plays for GPU tensors.
+
+O_DIRECT support is probed once per directory (overlay/tmpfs filesystems
+reject it) and the writer falls back to buffered mode with a one-time log
+line.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import warning_once
+from ..utils.tree_io import flatten_with_paths, start_d2h, to_host_arrays
+
+_ALIGN = 4096
+
+_ST_DTYPES = {
+    "float64": "F64", "float32": "F32", "float16": "F16",
+    "bfloat16": "BF16",
+    "int64": "I64", "int32": "I32", "int16": "I16", "int8": "I8",
+    "uint64": "U64", "uint32": "U32", "uint16": "U16", "uint8": "U8",
+    "bool": "BOOL",
+}
+
+
+def build_safetensors_header(arrays: Dict[str, np.ndarray],
+                             metadata: Optional[Dict[str, str]] = None
+                             ) -> Tuple[bytes, Dict[str, int], int]:
+    """The 8-byte length + JSON header of the safetensors format, with
+    contiguous data offsets in dict order.  Returns (header_bytes,
+    {name: data_offset}, total_data_bytes)."""
+    entries: Dict[str, Any] = {}
+    if metadata:
+        entries["__metadata__"] = {str(k): str(v) for k, v in metadata.items()}
+    offsets: Dict[str, int] = {}
+    pos = 0
+    for name, arr in arrays.items():
+        st_dtype = _ST_DTYPES.get(str(arr.dtype))
+        if st_dtype is None:
+            raise TypeError(f"{name}: dtype {arr.dtype} not representable "
+                            f"in safetensors")
+        offsets[name] = pos
+        entries[name] = {"dtype": st_dtype, "shape": list(arr.shape),
+                         "data_offsets": [pos, pos + arr.nbytes]}
+        pos += arr.nbytes
+    blob = json.dumps(entries, separators=(",", ":")).encode()
+    pad = (8 - (len(blob) + 8) % 8) % 8  # keep the data section 8-aligned
+    blob += b" " * pad
+    return len(blob).to_bytes(8, "little") + blob, offsets, pos
+
+
+def _aligned_buffer(nbytes: int) -> np.ndarray:
+    """Page-aligned uint8 buffer (O_DIRECT requires aligned addresses)."""
+    raw = np.empty(nbytes + _ALIGN, np.uint8)
+    shift = (-raw.ctypes.data) % _ALIGN
+    return raw[shift:shift + nbytes]
+
+
+_ODIRECT_CACHE: Dict[str, bool] = {}
+
+
+def probe_o_direct(directory: str) -> bool:
+    """Whether this filesystem accepts O_DIRECT (container overlayfs/tmpfs
+    typically do not — and some accept the open but fail the first aligned
+    write).  Result cached per directory."""
+    directory = os.path.abspath(directory)
+    cached = _ODIRECT_CACHE.get(directory)
+    if cached is not None:
+        return cached
+    from ..nvme.aio_handle import AsyncIOHandle
+
+    h = AsyncIOHandle(thread_count=1)
+    path = os.path.join(directory, f".odirect_probe_{os.getpid()}")
+    fd = None
+    ok = False
+    try:
+        fd = h.open_write(path, use_direct=True)
+        buf = _aligned_buffer(_ALIGN)
+        req = h.fd_pwrite(fd, buf, _ALIGN, 0)
+        h.wait(req)
+        ok = True
+    except OSError:
+        ok = False
+    finally:
+        if fd is not None:
+            try:
+                h.close(fd, sync=False)
+            except OSError:
+                pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    _ODIRECT_CACHE[directory] = ok
+    return ok
+
+
+class FastFileWriter:
+    """Writes safetensors files through the AIO pool.  One instance owns a
+    thread pool; reuse it across checkpoints (``get_fast_writer``)."""
+
+    def __init__(self, block_size: int = 8 << 20, queue_depth: int = 32,
+                 thread_count: int = 8, use_direct: Optional[bool] = None,
+                 stage_bytes: int = 32 << 20, fsync: bool = True):
+        from ..nvme.aio_handle import AsyncIOHandle
+
+        self._aio = AsyncIOHandle(block_size=block_size,
+                                  queue_depth=queue_depth,
+                                  thread_count=thread_count)
+        self.thread_count = thread_count
+        self.use_direct = use_direct  # None → probe per directory
+        # round UP to a page multiple; a sub-page stage would floor to 0 and
+        # the double-buffer fill loop could never make progress
+        self.stage_bytes = max(_ALIGN,
+                               (stage_bytes + _ALIGN - 1) // _ALIGN * _ALIGN)
+        self.fsync = fsync
+        self.last_stats: Dict[str, float] = {}
+
+    # -- mode selection -------------------------------------------------
+    def _direct_for(self, path: str) -> bool:
+        if self.use_direct is not None:
+            return self.use_direct
+        directory = os.path.dirname(os.path.abspath(path))
+        ok = probe_o_direct(directory)
+        if not ok:
+            warning_once(
+                f"FastPersist: O_DIRECT unsupported under {directory} — "
+                f"using buffered zero-copy writes")
+        return ok
+
+    # -- submission/drain helpers ---------------------------------------
+    def _submit_file(self, fd: int, arrays: Dict[str, np.ndarray],
+                     header: bytes, offsets: Dict[str, int],
+                     data_bytes: int) -> List[int]:
+        """Submit one file's header + zero-copy tensor segments; returns
+        the request ids.  Segment size spreads the payload over the pool
+        but never drops below 8 MiB (tiny segments = syscall overhead,
+        not parallelism)."""
+        h = self._aio
+        reqs = [h.fd_pwrite(fd, np.frombuffer(header, np.uint8),
+                            len(header), 0)]
+        base = len(header)
+        seg = max(8 << 20, data_bytes // max(self.thread_count, 1))
+        for name, arr in arrays.items():
+            if arr.nbytes == 0:
+                continue
+            file_off = base + offsets[name]
+            addr = arr.ctypes.data
+            for s in range(0, arr.nbytes, seg):
+                n = min(seg, arr.nbytes - s)
+                ptr = ctypes.c_void_p(addr + s)
+                req = h.fd_pwrite(fd, ptr, n, file_off + s)
+                # pin the ARRAY (not just the pointer) until it lands
+                h._pinned[req] = (arr, ptr)
+                reqs.append(req)
+        return reqs
+
+    def _drain_and_close(self, fds: List[int], reqs: List[int],
+                         truncate_to: int = -1) -> None:
+        """Wait out every request, then close.  On error, ALL in-flight
+        requests are still drained BEFORE any fd closes — pool threads
+        writing through a closed (and possibly reused) fd would corrupt
+        whatever file the kernel hands that number to next."""
+        err: Optional[BaseException] = None
+        for r in reqs:
+            try:
+                self._aio.wait(r)
+            except OSError as e:
+                err = err or e
+        for fd in fds:
+            try:
+                self._aio.close(fd, sync=self.fsync and err is None,
+                                truncate_to=truncate_to)
+            except OSError as e:
+                err = err or e
+        if err is not None:
+            raise err
+
+    # -- public API -----------------------------------------------------
+    def write_safetensors(self, arrays: Dict[str, np.ndarray], path: str,
+                          metadata: Optional[Dict[str, str]] = None) -> None:
+        """Write ``arrays`` as a safetensors file.  Arrays must be
+        C-contiguous host buffers; they are pinned until the write lands."""
+        arrays = {k: np.ascontiguousarray(v) for k, v in arrays.items()}
+        header, offsets, data_bytes = build_safetensors_header(arrays, metadata)
+        t0 = time.perf_counter()
+        if self._direct_for(path):
+            self._write_direct(arrays, path, header, data_bytes)
+            mode = "o_direct"
+        else:
+            fd = self._aio.open_write(path, use_direct=False)
+            reqs = self._submit_file(fd, arrays, header, offsets, data_bytes)
+            self._drain_and_close([fd], reqs)
+            mode = "buffered"
+        dt = time.perf_counter() - t0
+        total = len(header) + data_bytes
+        self.last_stats = {"bytes": total, "seconds": round(dt, 4),
+                           "mb_per_s": round(total / max(dt, 1e-9) / 2**20, 1),
+                           "mode": mode}
+
+    def _write_direct(self, arrays, path, header, data_bytes):
+        """Double-buffered O_DIRECT: serialize the logical stream into two
+        page-aligned staging buffers; buffer i's memcpy overlaps buffer
+        1-i's in-flight write.  The file is truncated to the logical size
+        at close (the last block is padded)."""
+        h = self._aio
+        logical = len(header) + data_bytes
+        stage = self.stage_bytes
+        bufs = [_aligned_buffer(stage), _aligned_buffer(stage)]
+        inflight: List[Optional[int]] = [None, None]
+
+        # the logical byte stream: header then tensors in offset order
+        def stream_chunks():
+            yield np.frombuffer(header, np.uint8)
+            for name, arr in arrays.items():
+                if arr.nbytes:
+                    yield arr.reshape(-1).view(np.uint8)
+
+        fd = h.open_write(path, use_direct=True)
+        try:
+            which = 0
+            filled = 0       # bytes staged in the current buffer
+            file_off = 0     # aligned offset of the current buffer's write
+            for chunk in stream_chunks():
+                pos = 0
+                while pos < chunk.nbytes:
+                    n = min(stage - filled, chunk.nbytes - pos)
+                    bufs[which][filled:filled + n] = chunk[pos:pos + n]
+                    filled += n
+                    pos += n
+                    if filled == stage:
+                        # submit this buffer, switch, and wait out the OTHER
+                        # buffer's in-flight write before refilling it — the
+                        # memcpy into one buffer rides the disk write of the
+                        # other (invariant: the buffer being filled never
+                        # has an in-flight write)
+                        inflight[which] = h.fd_pwrite(
+                            fd, bufs[which], stage, file_off)
+                        file_off += stage
+                        which = 1 - which
+                        if inflight[which] is not None:
+                            h.wait(inflight[which])
+                            inflight[which] = None
+                        filled = 0
+            if filled:
+                padded = (filled + _ALIGN - 1) // _ALIGN * _ALIGN
+                bufs[which][filled:padded] = 0
+                inflight[which] = h.fd_pwrite(fd, bufs[which], padded, file_off)
+        except BaseException:
+            # drain whatever made it into the pool before the fd closes
+            self._drain_and_close(
+                [fd], [r for r in inflight if r is not None],
+                truncate_to=logical)
+            raise
+        else:
+            self._drain_and_close([fd], [r for r in inflight if r is not None],
+                                  truncate_to=logical)
+
+    def save_tree(self, tree: Any, path: str) -> None:
+        """Pytree → safetensors with the native checkpoint conventions
+        (bf16 stored as a U16 view + ``bf16_keys`` metadata — shared with
+        the native engine via ``utils.tree_io``), D2H overlap via
+        ``copy_to_host_async``."""
+        self.save_trees([(tree, path)])
+
+    def save_trees(self, trees_and_paths) -> None:
+        """Write SEVERAL pytrees (e.g. model + optimizer) concurrently: all
+        files' chunk writes share the AIO pool and a single drain.  On a
+        bandwidth-bound disk this overlaps each file's writeback with the
+        others' (IO_BENCH.md: 1.25x durable)."""
+        flats = [(flatten_with_paths(tree), path)
+                 for tree, path in trees_and_paths]
+        start_d2h([leaf for flat, _ in flats for leaf in flat.values()])
+        jobs = []
+        for flat, path in flats:
+            arrays, bf16_keys = to_host_arrays(flat, contiguous=True)
+            jobs.append((arrays, path,
+                         {"bf16_keys": json.dumps(sorted(bf16_keys))}))
+        if len(jobs) == 1 or self._direct_for(jobs[0][1]):
+            # O_DIRECT staging is inherently sequential per writer — run
+            # files one after another through the double buffer
+            for arrays, path, md in jobs:
+                self.write_safetensors(arrays, path, metadata=md)
+            return
+        # buffered: submit every file's writes, drain once
+        t0 = time.perf_counter()
+        fds, reqs, total = [], [], 0
+        try:
+            for arrays, path, md in jobs:
+                header, offsets, data_bytes = build_safetensors_header(
+                    arrays, md)
+                total += len(header) + data_bytes
+                fd = self._aio.open_write(path, use_direct=False)
+                fds.append(fd)
+                reqs.extend(self._submit_file(fd, arrays, header, offsets,
+                                              data_bytes))
+        except BaseException:
+            self._drain_and_close(fds, reqs)
+            raise
+        self._drain_and_close(fds, reqs)
+        dt = time.perf_counter() - t0
+        self.last_stats = {"bytes": total, "seconds": round(dt, 4),
+                           "mb_per_s": round(total / max(dt, 1e-9) / 2**20, 1),
+                           "mode": f"buffered_x{len(jobs)}"}
+
+
+_WRITER: Optional[FastFileWriter] = None
+
+
+def get_fast_writer() -> FastFileWriter:
+    global _WRITER
+    if _WRITER is None:
+        _WRITER = FastFileWriter()
+    return _WRITER

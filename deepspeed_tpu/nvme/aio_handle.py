@@ -65,6 +65,23 @@ def _lib() -> ctypes.CDLL:
             lib.aio_alloc_aligned.restype = ctypes.c_void_p
             lib.aio_alloc_aligned.argtypes = [ctypes.c_int64, ctypes.c_int64]
             lib.aio_free_aligned.argtypes = [ctypes.c_void_p]
+            # fd-based writer API (FastPersist)
+            lib.aio_file_open_write.restype = ctypes.c_int64
+            lib.aio_file_open_write.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                                ctypes.c_int]
+            lib.aio_file_open_read.restype = ctypes.c_int64
+            lib.aio_file_open_read.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.aio_file_close.restype = ctypes.c_int64
+            lib.aio_file_close.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                           ctypes.c_int64]
+            lib.aio_fd_pwrite.restype = ctypes.c_int64
+            lib.aio_fd_pwrite.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_int64]
+            lib.aio_fd_pread.restype = ctypes.c_int64
+            lib.aio_fd_pread.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_int64]
             _LIB = lib
     return _LIB
 
@@ -125,6 +142,48 @@ class AsyncIOHandle:
         if rc < 0:
             raise OSError(-rc, f"aio wait_all failed: {os.strerror(-rc)}")
         return rc
+
+    # -- fd-based API (FastPersist writer: open once, chunk writes at
+    # offsets from the C++ thread pool, fsync+close once) --------------
+    def open_write(self, path: str, use_direct: bool = False,
+                   truncate: bool = True) -> int:
+        fd = self._lib.aio_file_open_write(path.encode(),
+                                           1 if use_direct else 0,
+                                           1 if truncate else 0)
+        if fd < 0:
+            raise OSError(-fd, f"open {path}: {os.strerror(-fd)}")
+        return fd
+
+    def open_read(self, path: str, use_direct: bool = False) -> int:
+        fd = self._lib.aio_file_open_read(path.encode(),
+                                          1 if use_direct else 0)
+        if fd < 0:
+            raise OSError(-fd, f"open {path}: {os.strerror(-fd)}")
+        return fd
+
+    def close(self, fd: int, sync: bool = True, truncate_to: int = -1) -> None:
+        rc = self._lib.aio_file_close(fd, 1 if sync else 0, truncate_to)
+        if rc < 0:
+            raise OSError(-rc, f"close fd {fd}: {os.strerror(-rc)}")
+
+    def fd_pwrite(self, fd: int, buffer, nbytes: int, file_offset: int) -> int:
+        """Async write of a raw (address, nbytes) region; ``buffer`` may be a
+        numpy array (kept alive until wait) or a ctypes pointer."""
+        if isinstance(buffer, np.ndarray):
+            addr = buffer.ctypes.data_as(ctypes.c_void_p)
+        else:
+            addr = buffer
+        req = self._lib.aio_fd_pwrite(self._h, fd, addr, nbytes, file_offset)
+        self._pinned[req] = buffer
+        return req
+
+    def fd_pread(self, fd: int, buffer: np.ndarray, nbytes: int,
+                 file_offset: int) -> int:
+        req = self._lib.aio_fd_pread(
+            self._h, fd, buffer.ctypes.data_as(ctypes.c_void_p), nbytes,
+            file_offset)
+        self._pinned[req] = buffer
+        return req
 
     # -- sync convenience ---------------------------------------------
     def sync_pread(self, path: str, buffer: np.ndarray, file_offset: int = 0) -> int:

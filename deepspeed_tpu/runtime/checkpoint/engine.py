@@ -32,28 +32,19 @@ _SAVE_LOCK = threading.Lock()
 _async_threads = []
 
 
-def _flatten_with_paths(tree: Any) -> Dict[str, Any]:
-    flat = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        flat[key] = leaf
-    return flat
+from ...utils.tree_io import flatten_with_paths as _flatten_with_paths  # noqa: E402
+from ...utils.tree_io import to_host_arrays  # noqa: E402
 
 
 def _save_tree(tree: Any, path: str) -> None:
-    """Write a pytree as a safetensors file + a structure descriptor."""
+    """Write a pytree as a safetensors file + a structure descriptor.
+    Naming/bf16 conventions live in ``utils.tree_io`` — shared with the
+    FastPersist writer so both engines' files stay mutually loadable."""
     from safetensors.numpy import save_file
 
-    flat = _flatten_with_paths(tree)
-    arrays = {}
-    meta = {}
-    for k, v in flat.items():
-        arr = np.asarray(jax.device_get(v))
-        if arr.dtype == jnp.bfloat16:
-            meta[k] = "bfloat16"
-            arr = arr.view(np.uint16)
-        arrays[k] = arr
-    save_file(arrays, path, metadata={"bf16_keys": json.dumps(sorted(meta))})
+    arrays, bf16_keys = to_host_arrays(_flatten_with_paths(tree))
+    save_file(arrays, path,
+              metadata={"bf16_keys": json.dumps(sorted(bf16_keys))})
 
 
 def _load_tree_flat(path: str) -> Dict[str, np.ndarray]:
@@ -117,11 +108,26 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "framework_version": _version(),
     }
 
+    def _write_trees():
+        model_path = os.path.join(ckpt_dir, "model.safetensors")
+        opt_path = os.path.join(ckpt_dir, "optimizer.safetensors")
+        if cfg.engine == "fast":
+            # FastPersist (reference: fast_checkpoint_engine.py + io/
+            # fast_file_writer.py): same on-disk safetensors layout, written
+            # through the C++ AIO pool with BOTH files' chunks in flight
+            # together — the loader is unchanged
+            from ...io.fast_writer import get_fast_writer
+
+            get_fast_writer().save_trees(
+                [(host_params, model_path), (host_opt, opt_path)])
+        else:
+            _save_tree(host_params, model_path)
+            _save_tree(host_opt, opt_path)
+
     def _do_save():
         with _SAVE_LOCK:
             os.makedirs(ckpt_dir, exist_ok=True)
-            _save_tree(host_params, os.path.join(ckpt_dir, "model.safetensors"))
-            _save_tree(host_opt, os.path.join(ckpt_dir, "optimizer.safetensors"))
+            _write_trees()
             with open(os.path.join(ckpt_dir, "engine_state.json"), "w") as f:
                 json.dump(meta, f, indent=2)
             with open(os.path.join(save_dir, _LATEST), "w") as f:

@@ -1,0 +1,55 @@
+"""Shared pytree↔checkpoint-array conventions.
+
+Single source of truth for how checkpoints name tensors (slash-joined
+pytree key paths) and how bf16 is stored (as a uint16 view + a
+``bf16_keys`` metadata list), used by BOTH the native checkpoint engine
+(``runtime/checkpoint/engine.py``) and the FastPersist writer
+(``io/fast_writer.py``) — if either convention changed in one place only,
+fast checkpoints would stop being loadable by the native loader.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+
+def flatten_with_paths(tree: Any) -> Dict[str, Any]:
+    """{slash/joined/path: leaf} in deterministic pytree order."""
+    import jax
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        flat[key] = leaf
+    return flat
+
+
+def to_host_arrays(flat: Dict[str, Any], contiguous: bool = False
+                   ) -> Tuple[Dict[str, np.ndarray], List[str]]:
+    """Materialize leaves on host; bf16 becomes a uint16 view and its key is
+    recorded (the loader re-views via the ``bf16_keys`` metadata)."""
+    import jax
+    import jax.numpy as jnp
+
+    arrays: Dict[str, np.ndarray] = {}
+    bf16_keys: List[str] = []
+    for k, v in flat.items():
+        arr = np.asarray(jax.device_get(v))
+        if arr.dtype == jnp.bfloat16:
+            bf16_keys.append(k)
+            arr = arr.view(np.uint16)
+        arrays[k] = np.ascontiguousarray(arr) if contiguous else arr
+    return arrays, bf16_keys
+
+
+def start_d2h(leaves) -> None:
+    """Kick off async device→host copies so later ``device_get``s overlap."""
+    for leaf in leaves:
+        if hasattr(leaf, "copy_to_host_async"):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:
+                pass
