@@ -184,10 +184,7 @@ class FastFileWriter:
             for s in range(0, arr.nbytes, seg):
                 n = min(seg, arr.nbytes - s)
                 ptr = ctypes.c_void_p(addr + s)
-                req = h.fd_pwrite(fd, ptr, n, file_off + s)
-                # pin the ARRAY (not just the pointer) until it lands
-                h._pinned[req] = (arr, ptr)
-                reqs.append(req)
+                reqs.append(h.fd_pwrite(fd, ptr, n, file_off + s, pin=arr))
         return reqs
 
     def _drain_and_close(self, fds: List[int], reqs: List[int],

@@ -166,15 +166,23 @@ class AsyncIOHandle:
         if rc < 0:
             raise OSError(-rc, f"close fd {fd}: {os.strerror(-rc)}")
 
-    def fd_pwrite(self, fd: int, buffer, nbytes: int, file_offset: int) -> int:
-        """Async write of a raw (address, nbytes) region; ``buffer`` may be a
-        numpy array (kept alive until wait) or a ctypes pointer."""
+    def fd_pwrite(self, fd: int, buffer, nbytes: int, file_offset: int,
+                  pin=None) -> int:
+        """Async write of a raw (address, nbytes) region.  ``buffer`` may be
+        a numpy array (kept alive until wait) or a ctypes pointer — a bare
+        pointer does NOT keep the addressed memory alive, so callers passing
+        one MUST pass the owning object via ``pin``."""
         if isinstance(buffer, np.ndarray):
             addr = buffer.ctypes.data_as(ctypes.c_void_p)
         else:
             addr = buffer
+            if pin is None:
+                raise ValueError(
+                    "fd_pwrite with a raw pointer requires pin= (the object "
+                    "owning the memory) — without it the buffer can be "
+                    "collected while a pool thread still reads it")
         req = self._lib.aio_fd_pwrite(self._h, fd, addr, nbytes, file_offset)
-        self._pinned[req] = buffer
+        self._pinned[req] = buffer if pin is None else (pin, buffer)
         return req
 
     def fd_pread(self, fd: int, buffer: np.ndarray, nbytes: int,

@@ -54,6 +54,51 @@ def hlo_collective_census(hlo_text: str) -> Dict[str, Any]:
             "total_async": int(sum(async_pairs.values()))}
 
 
+_DTYPE_BYTES = {
+    "f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4, "u32": 4,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+}
+
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*(?:e\d+m\d+)?)\[([0-9,]*)\]")
+
+
+def hlo_collective_bytes(hlo_text: str) -> Dict[str, int]:
+    """Result-shape bytes of every collective instruction, by op — an
+    auditable proxy for wire volume (an all-gather's result is what the
+    device receives; an all-reduce moves ~2x its shape on a ring, uniformly
+    for all schemes compared).  ``*-done`` lines are skipped so async pairs
+    count once."""
+    out: Dict[str, int] = collections.Counter()
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        for coll in _COLLECTIVES:
+            # result shapes sit between '=' and the op call; the instruction
+            # NAME left of '=' usually contains the op name too, so anchor
+            # the search after '='
+            m = re.search(rf"=\s*(.*?)\b{coll}(-start)?(?:\.\d+)?\(", line)
+            if m is None:
+                continue
+            shapes = _SHAPE_RE.findall(m.group(1))
+            if m.group(2) and len(shapes) >= 2 and len(shapes) % 2 == 0:
+                # async start results are (operand-alias…, result…) tuples —
+                # count only the result half or the start form reads ~2x the
+                # sync form of the same collective
+                shapes = shapes[len(shapes) // 2:]
+            nbytes = 0
+            for dt, dims in shapes:
+                size = _DTYPE_BYTES.get(dt)
+                if size is None:
+                    continue
+                n = 1
+                for d in dims.split(","):
+                    if d:
+                        n *= int(d)
+                nbytes += n * size
+            out[coll] += nbytes
+            break
+    return dict(out)
+
+
 def multichip_step_evidence(n_devices: int = 8) -> Dict[str, Any]:
     """Compile the flagship-architecture training step under
     {dp,fsdp,tp} sharding on a virtual mesh; census the optimized HLO."""

@@ -108,9 +108,20 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "framework_version": _version(),
     }
 
+    # 1-bit wire-compression residuals are optimizer-coupled engine state:
+    # dropping them on resume injects a one-shot gradient-bias spike, so
+    # they ride in their own file (absent → restored as zeros with a warning)
+    host_onebit = None
+    if getattr(engine, "_onebit_wres", None) is not None:
+        host_onebit = jax.device_get({"worker": engine._onebit_wres,
+                                      "server": engine._onebit_sres})
+
     def _write_trees():
         model_path = os.path.join(ckpt_dir, "model.safetensors")
         opt_path = os.path.join(ckpt_dir, "optimizer.safetensors")
+        if host_onebit is not None:
+            _save_tree(host_onebit,
+                       os.path.join(ckpt_dir, "onebit_residuals.safetensors"))
         if cfg.engine == "fast":
             # FastPersist (reference: fast_checkpoint_engine.py + io/
             # fast_file_writer.py): same on-disk safetensors layout, written
@@ -257,6 +268,26 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         skipped_steps=jnp.asarray(meta["skipped_steps"], jnp.int32),
     )
     engine.global_steps = meta["step"]
+    if getattr(engine, "_onebit_wres", None) is not None:
+        res_path = os.path.join(ckpt_dir, "onebit_residuals.safetensors")
+        template = {"worker": engine._onebit_wres,
+                    "server": engine._onebit_sres}
+        if os.path.exists(res_path):
+            loaded = _unflatten_like(template, _load_tree_flat(res_path))
+            loaded = jax.tree.map(
+                lambda x, t: jax.device_put(jnp.asarray(x), t.sharding),
+                loaded, template)
+            engine._onebit_wres = loaded["worker"]
+            engine._onebit_sres = loaded["server"]
+        else:
+            logger.warning(
+                "checkpoint has no onebit_residuals.safetensors — 1-bit "
+                "error-feedback restarts from zero (one-shot gradient-bias "
+                "transient on resume)")
+            engine._onebit_wres = jax.tree.map(jnp.zeros_like,
+                                               engine._onebit_wres)
+            engine._onebit_sres = jax.tree.map(jnp.zeros_like,
+                                               engine._onebit_sres)
     log_dist(f"loaded checkpoint {ckpt_dir} (step {meta['step']})")
     return ckpt_dir, meta.get("client_state", {})
 

@@ -73,13 +73,68 @@ def error_feedback_compression(freeze_step: int = 100) -> optax.GradientTransfor
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+class FrozenVarAdamState(NamedTuple):
+    count: jax.Array
+    mu: Any
+    nu: Any
+
+
+def scale_by_adam_freezable(b1: float = 0.9, b2: float = 0.999,
+                            eps: float = 1e-8, freeze_step: int = 100
+                            ) -> optax.GradientTransformation:
+    """Adam whose second moment FREEZES after ``freeze_step`` — the core of
+    1-bit Adam (reference ``onebit/adam.py``): sign-compressed gradients
+    carry no magnitude, so the variance term must stop adapting once
+    compression starts or the update scale collapses.  Bias correction for
+    ``nu`` is pinned at the freeze point for the same reason."""
+
+    def init_fn(params):
+        z = lambda p: jnp.zeros_like(p, jnp.float32)
+        return FrozenVarAdamState(count=jnp.zeros((), jnp.int32),
+                                  mu=jax.tree.map(z, params),
+                                  nu=jax.tree.map(z, params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        frozen = count > freeze_step
+        mu = jax.tree.map(
+            lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+            state.mu, updates)
+        nu = jax.tree.map(
+            lambda v, g: jnp.where(
+                frozen, v, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32))),
+            state.nu, updates)
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        # nu's bias correction stops advancing at the freeze point
+        c2 = 1 - b2 ** jnp.minimum(count, freeze_step).astype(jnp.float32)
+        new_updates = jax.tree.map(
+            lambda m, v, g: ((m / c1) / (jnp.sqrt(v / c2) + eps)
+                             ).astype(g.dtype),
+            mu, nu, updates)
+        return new_updates, FrozenVarAdamState(count, mu, nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def onebit_adam(learning_rate, weight_decay: float = 0.0, freeze_step: int = 100,
                 b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                compress_gradients: bool = True,
                 ) -> optax.GradientTransformation:
     """1-bit Adam (reference ``onebit/adam.py``): full-precision Adam during
-    warmup; after ``freeze_step``, gradients go through 1-bit error-feedback
-    compression before the (frozen-variance) update."""
-    return optax.chain(
-        error_feedback_compression(freeze_step=freeze_step),
-        optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay),
-    )
+    warmup; after ``freeze_step`` the variance freezes and gradients go
+    through 1-bit error-feedback compression.
+
+    ``compress_gradients=False`` drops the in-optimizer compression stage —
+    used when the ENGINE already compresses the gradient on the wire
+    (``gradient_compression.enabled``, the real DP-traffic path in
+    ``ops/onebit.py``); compressing twice would square the error."""
+    stages = []
+    if compress_gradients:
+        stages.append(error_feedback_compression(freeze_step=freeze_step))
+    stages.append(scale_by_adam_freezable(b1=b1, b2=b2, eps=eps,
+                                          freeze_step=freeze_step))
+    if weight_decay:
+        stages.append(optax.add_decayed_weights(weight_decay))
+    stages.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*stages)

@@ -188,7 +188,9 @@ class TrainingEngine:
         chain = []
         if config.gradient_clipping and config.gradient_clipping > 0:
             chain.append(optax.clip_by_global_norm(config.gradient_clipping))
-        chain.append(create_optimizer(config.optimizer, self.lr_schedule, wd_mask))
+        chain.append(create_optimizer(
+            config.optimizer, self.lr_schedule, wd_mask,
+            wire_compression=config.gradient_compression.enabled))
         self.optimizer = optax.chain(*chain)
 
         # ---- offload mode --------------------------------------------
@@ -265,6 +267,26 @@ class TrainingEngine:
                 raise ConfigError(
                     "zero_quantized_weights + offload_optimizer is not "
                     "supported")
+        if config.gradient_compression.enabled:
+            # same structural constraints as qgZ: the manual shard_map DP
+            # reduction owns the gradient traffic
+            if self.offload_enabled:
+                raise ConfigError(
+                    "gradient_compression + offload_optimizer is not supported")
+            if config.zero_optimization.zero_quantized_gradients:
+                raise ConfigError(
+                    "gradient_compression and zero_quantized_gradients are "
+                    "both wire-compression schemes — enable one")
+            if stage >= 3:
+                raise ConfigError(
+                    "gradient_compression requires stage <= 2 (params must be "
+                    "replicated across the dp axes for the manual reduction)")
+            for ax in ("tp", "sp", "ep", "pp"):
+                if topo.size(ax) > 1:
+                    raise ConfigError(
+                        f"gradient_compression cannot combine with {ax} "
+                        "parallelism (model-internal collectives cannot nest "
+                        "inside the manual dp reduction)")
 
         # ---- state init (sharded at construction) ---------------------
         self.opt_shardings = None  # set inside _init_state
@@ -305,6 +327,8 @@ class TrainingEngine:
             self._grad_step = self._build_grad_step()
         else:
             self._train_step = self._build_train_step()
+            if config.gradient_compression.enabled:
+                self._init_onebit()
         self._eval_step = self._build_eval_step()
 
         # ---- observability -------------------------------------------
@@ -412,7 +436,44 @@ class TrainingEngine:
     # the jitted step
     # ------------------------------------------------------------------
 
-    def _build_train_step(self):
+    # ---- 1-bit wire compression (reference: runtime/comm/nccl.py) -----
+    _ONEBIT_MIN_NUMEL = 2048  # leaves below this psum exactly
+    _ONEBIT_BLOCK = 2048      # scale-block length (multiple of 8)
+
+    def _onebit_freeze_step(self) -> int:
+        """Warmup length before compression engages: the optimizer's own
+        freeze_step when a 1-bit optimizer is configured (variance freeze
+        and wire compression must flip together), else the
+        gradient_compression config value."""
+        name = self.config.optimizer.type.lower().replace("_", "")
+        if name in ("onebitadam", "zerooneadam", "onebitlamb"):
+            return int(self.config.optimizer.params.get("freeze_step", 100))
+        return int(self.config.gradient_compression.freeze_step)
+
+    def _init_onebit(self) -> None:
+        """Error-feedback residuals (worker + server, per compressed leaf)
+        and the compressed-reduction step function.  Residuals are (W, len)
+        fp32 sharded over the dp axes — each shard owns its own feedback."""
+        from jax.sharding import NamedSharding
+        from ..ops.onebit import residual_shapes
+
+        W = int(self.topo.dp_world_size)
+        sh = NamedSharding(self.topo.mesh, P(("dp", "fsdp")))
+
+        def mk(leaf, slot):
+            if leaf.size >= self._ONEBIT_MIN_NUMEL:
+                # worker residual (slot 0): each shard's FULL padded vector;
+                # server residual (slot 1): each shard's own chunk
+                n = residual_shapes(leaf.size, W, self._ONEBIT_BLOCK)[slot]
+            else:
+                n = 0
+            return jax.device_put(jnp.zeros((W, n), jnp.float32), sh)
+
+        self._onebit_wres = jax.tree.map(lambda l: mk(l, 0), self.state.params)
+        self._onebit_sres = jax.tree.map(lambda l: mk(l, 1), self.state.params)
+        self._train_step_onebit = self._build_train_step(onebit=True)
+
+    def _build_train_step(self, onebit: bool = False):
         cfg = self.config
         gas = self.batch_config.gradient_accumulation_steps
         loss_fn = self.model.loss_fn
@@ -443,7 +504,7 @@ class TrainingEngine:
         qgz = cfg.zero_optimization.zero_quantized_gradients
 
         def step_fn(state: EngineState, batch: Dict[str, jax.Array],
-                    lr_scale=None):
+                    residuals=None, lr_scale=None):
             # lr_scale: per-batch LR multiplier from the variable-batch
             # sampler (data_sampling/variable_batch_size_and_lr.py); None
             # (the default trace) compiles the scale away entirely.
@@ -478,7 +539,57 @@ class TrainingEngine:
                                     jax.tree.map(lambda x: x[0], batch))
                 return g, m
 
-            if qgz:
+            new_residuals = residuals
+            if onebit:
+                # 1-bit Adam wire path (reference runtime/comm/nccl.py
+                # compressed_allreduce): explicit DP; large leaves reduce
+                # through the two-phase sign-compressed scheme with worker +
+                # server error feedback (ops/onebit.py), ~32x less gradient
+                # traffic; small leaves psum exactly.
+                from jax import shard_map
+                from ..ops.onebit import onebit_all_reduce
+
+                dp_axes = ("dp", "fsdp")
+                W = int(self.topo.dp_world_size)
+                ws = float(W)
+                wres_in, sres_in = residuals
+
+                def local(params, batch, wres, sres):
+                    g, m = accumulate(params, batch)
+
+                    def red(t, w, s):
+                        if t.size >= self._ONEBIT_MIN_NUMEL:
+                            # the primitive computes the MEAN internally —
+                            # pre-dividing (the qgZ sum-semantics convention)
+                            # would shrink compressed grads by another 1/W
+                            out, nw, ns = onebit_all_reduce(
+                                t, w[0], s[0], dp_axes, W,
+                                self._ONEBIT_BLOCK)
+                            return out, nw[None], ns[None]
+                        return jax.lax.psum(t / ws, dp_axes), w, s
+
+                    triples = jax.tree.map(red, g, wres, sres)
+                    is3 = lambda x: isinstance(x, tuple) and len(x) == 3
+                    g = jax.tree.map(lambda tr: tr[0], triples, is_leaf=is3)
+                    nw = jax.tree.map(lambda tr: tr[1], triples, is_leaf=is3)
+                    ns = jax.tree.map(lambda tr: tr[2], triples, is_leaf=is3)
+                    m = jax.tree.map(lambda t: jax.lax.psum(t / ws, dp_axes), m)
+                    return g, m, nw, ns
+
+                batch_specs = jax.tree.map(
+                    lambda _: P(None, ("dp", "fsdp")), batch)
+                rep = jax.tree.map(lambda _: P(), state.params)
+                res_spec = jax.tree.map(lambda _: P(("dp", "fsdp")),
+                                        state.params)
+                grads, msum, new_w, new_s = shard_map(
+                    local, mesh=self.topo.mesh,
+                    in_specs=(rep, batch_specs, res_spec, res_spec),
+                    out_specs=(rep,
+                               jax.tree.map(lambda _: P(), zero_metrics),
+                               res_spec, res_spec),
+                    check_vma=False)(state.params, batch, wres_in, sres_in)
+                new_residuals = (new_w, new_s)
+            elif qgz:
                 # ZeRO++ qgZ: explicit DP with int8-compressed gradient
                 # reduction (ops/quantizer.compressed_all_reduce) instead of
                 # XLA's exact psum — 4x less gradient traffic over DCN.
@@ -585,9 +696,19 @@ class TrainingEngine:
             if lr_scale is not None:
                 metrics["lr"] = metrics["lr"] * lr_scale
             metrics["overflow"] = (~finite).astype(jnp.float32)
+            if onebit:
+                return new_state, metrics, new_residuals
             return new_state, metrics
 
-        return jax.jit(step_fn, donate_argnums=(0,))
+        if onebit:
+            # residuals donated: they are rewritten every step
+            return jax.jit(step_fn, donate_argnums=(0, 2))
+
+        def step_compat(state, batch, lr_scale=None):
+            # positional-compat wrapper: existing callers pass lr_scale third
+            return step_fn(state, batch, None, lr_scale)
+
+        return jax.jit(step_compat, donate_argnums=(0,))
 
     def _build_grad_step(self):
         """Device half of the offloaded step: fwd+bwd+accumulate only.
@@ -779,6 +900,16 @@ class TrainingEngine:
         placed = self._place_batch(batch, allow_variable=lr_scale is not None)
         if self.offload_enabled:
             out = self._train_batch_offloaded(placed, lr_scale)
+        elif (getattr(self, "_train_step_onebit", None) is not None
+                and self.global_steps >= self._onebit_freeze_step()):
+            # 1-bit wire compression engages after the warmup ("freeze")
+            # phase, matching the optimizer's variance freeze — host-side
+            # switch, so each variant stays a single compiled program
+            residuals = (self._onebit_wres, self._onebit_sres)
+            self.state, metrics, residuals = self._train_step_onebit(
+                self.state, placed, residuals, lr_scale)
+            self._onebit_wres, self._onebit_sres = residuals
+            out = LazyMetrics(metrics)
         else:
             if lr_scale is None:
                 self.state, metrics = self._train_step(self.state, placed)

@@ -35,8 +35,14 @@ def _adam_args(params: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def create_optimizer(cfg: OptimizerConfig, learning_rate: Schedule,
-                     weight_decay_mask: Optional[Any] = None) -> optax.GradientTransformation:
-    """Build the base optimizer from config (reference: engine.py:1960)."""
+                     weight_decay_mask: Optional[Any] = None,
+                     wire_compression: bool = False) -> optax.GradientTransformation:
+    """Build the base optimizer from config (reference: engine.py:1960).
+
+    ``wire_compression``: the engine compresses gradients on the DP wire
+    (``gradient_compression.enabled``) — 1-bit optimizers then skip their
+    in-optimizer compression stage (it would compress twice) and keep only
+    the frozen-variance update."""
     name = cfg.type.lower().replace("_", "")
     p = cfg.params
     wd = p.get("weight_decay", 0.0)
@@ -79,7 +85,9 @@ def create_optimizer(cfg: OptimizerConfig, learning_rate: Schedule,
         from .compressed_optimizer import onebit_adam
 
         return onebit_adam(learning_rate, weight_decay=wd,
-                           freeze_step=p.get("freeze_step", 100), **_adam_args(p))
+                           freeze_step=p.get("freeze_step", 100),
+                           compress_gradients=not wire_compression,
+                           **_adam_args(p))
     raise ConfigError(f"unknown optimizer type {cfg.type!r}")
 
 

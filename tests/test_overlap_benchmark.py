@@ -81,3 +81,14 @@ def test_multichip_compile_evidence(devices):
     assert "all-gather" in ev["collectives"], ev
     assert ("all-reduce" in ev["collectives"]
             or "reduce-scatter" in ev["collectives"]), ev
+
+
+def test_hlo_collective_bytes_async_tuple_counts_result_half():
+    """*-start results are (alias..., result...) tuples — only the result
+    half may count, or async forms read ~2x their sync equivalents."""
+    from deepspeed_tpu.profiling.compile_evidence import hlo_collective_bytes
+
+    sync = "x = f32[1024]{0} all-reduce(y), replica_groups={}"
+    asy = "x = (f32[1024]{0}, f32[1024]{0}) all-reduce-start(y), dims={}"
+    assert hlo_collective_bytes(sync)["all-reduce"] == 4096
+    assert hlo_collective_bytes(asy)["all-reduce"] == 4096
