@@ -272,18 +272,30 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         res_path = os.path.join(ckpt_dir, "onebit_residuals.safetensors")
         template = {"worker": engine._onebit_wres,
                     "server": engine._onebit_sres}
+        shapes_match = False
         if os.path.exists(res_path):
             loaded = _unflatten_like(template, _load_tree_flat(res_path))
+            shapes_match = all(
+                tuple(a.shape) == tuple(b.shape)
+                for a, b in zip(jax.tree.leaves(loaded),
+                                jax.tree.leaves(template)))
+            if not shapes_match:
+                logger.warning(
+                    "onebit residual shapes in the checkpoint do not match "
+                    "this engine's dp world — residuals restart from zero "
+                    "(the per-worker feedback is topology-bound)")
+        elif not os.path.exists(res_path):
+            logger.warning(
+                "checkpoint has no onebit_residuals.safetensors — 1-bit "
+                "error-feedback restarts from zero (one-shot gradient-bias "
+                "transient on resume)")
+        if shapes_match:
             loaded = jax.tree.map(
                 lambda x, t: jax.device_put(jnp.asarray(x), t.sharding),
                 loaded, template)
             engine._onebit_wres = loaded["worker"]
             engine._onebit_sres = loaded["server"]
         else:
-            logger.warning(
-                "checkpoint has no onebit_residuals.safetensors — 1-bit "
-                "error-feedback restarts from zero (one-shot gradient-bias "
-                "transient on resume)")
             engine._onebit_wres = jax.tree.map(jnp.zeros_like,
                                                engine._onebit_wres)
             engine._onebit_sres = jax.tree.map(jnp.zeros_like,

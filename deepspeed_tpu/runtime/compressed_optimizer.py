@@ -119,7 +119,7 @@ def scale_by_adam_freezable(b1: float = 0.9, b2: float = 0.999,
 
 def onebit_adam(learning_rate, weight_decay: float = 0.0, freeze_step: int = 100,
                 b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
-                compress_gradients: bool = True,
+                compress_gradients: bool = True, mask=None,
                 ) -> optax.GradientTransformation:
     """1-bit Adam (reference ``onebit/adam.py``): full-precision Adam during
     warmup; after ``freeze_step`` the variance freezes and gradients go
@@ -135,6 +135,6 @@ def onebit_adam(learning_rate, weight_decay: float = 0.0, freeze_step: int = 100
     stages.append(scale_by_adam_freezable(b1=b1, b2=b2, eps=eps,
                                           freeze_step=freeze_step))
     if weight_decay:
-        stages.append(optax.add_decayed_weights(weight_decay))
+        stages.append(optax.add_decayed_weights(weight_decay, mask=mask))
     stages.append(optax.scale_by_learning_rate(learning_rate))
     return optax.chain(*stages)

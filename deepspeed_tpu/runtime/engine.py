@@ -273,6 +273,12 @@ class TrainingEngine:
             if self.offload_enabled:
                 raise ConfigError(
                     "gradient_compression + offload_optimizer is not supported")
+            if self.fp16_enabled:
+                raise ConfigError(
+                    "gradient_compression requires bf16/fp32: error-feedback "
+                    "residuals live in the loss-scaled domain, so a dynamic "
+                    "scale change (or one overflow poisoning them with NaN) "
+                    "breaks the compensation — use bf16")
             if config.zero_optimization.zero_quantized_gradients:
                 raise ConfigError(
                     "gradient_compression and zero_quantized_gradients are "
@@ -467,7 +473,14 @@ class TrainingEngine:
                 n = residual_shapes(leaf.size, W, self._ONEBIT_BLOCK)[slot]
             else:
                 n = 0
-            return jax.device_put(jnp.zeros((W, n), jnp.float32), sh)
+            if n == 0:  # XLA rejects sharding overrides on 0-sized arrays
+                return jax.device_put(jnp.zeros((W, 0), jnp.float32), sh)
+            # allocate DIRECTLY sharded: a device_put of a materialized
+            # (W, n) buffer would stage W copies of the leaf's fp32 size on
+            # one device before resharding — OOM at exactly the scale this
+            # feature targets
+            return jax.jit(lambda: jnp.zeros((W, n), jnp.float32),
+                           out_shardings=sh)()
 
         self._onebit_wres = jax.tree.map(lambda l: mk(l, 0), self.state.params)
         self._onebit_sres = jax.tree.map(lambda l: mk(l, 1), self.state.params)
@@ -540,19 +553,34 @@ class TrainingEngine:
                 return g, m
 
             new_residuals = residuals
+            dp_axes = ("dp", "fsdp")
+            ws = float(self.topo.dp_world_size)
+
+            def explicit_dp(local_fn, extra_in=(), extra_specs=()):
+                """Shared scaffolding of the manual-DP wire-compression
+                paths (1-bit and qgZ): params replicated in, batch sharded
+                over dp, grads/metrics replicated out; ``extra`` pytrees
+                (residuals) ride sharded over the dp axes."""
+                from jax import shard_map
+
+                batch_specs = jax.tree.map(lambda _: P(None, dp_axes), batch)
+                rep = jax.tree.map(lambda _: P(), state.params)
+                mspec = jax.tree.map(lambda _: P(), zero_metrics)
+                return shard_map(
+                    local_fn, mesh=self.topo.mesh,
+                    in_specs=(rep, batch_specs) + tuple(extra_specs),
+                    out_specs=(rep, mspec) + tuple(extra_specs),
+                    check_vma=False)(state.params, batch, *extra_in)
+
             if onebit:
                 # 1-bit Adam wire path (reference runtime/comm/nccl.py
-                # compressed_allreduce): explicit DP; large leaves reduce
-                # through the two-phase sign-compressed scheme with worker +
-                # server error feedback (ops/onebit.py), ~32x less gradient
+                # compressed_allreduce): large leaves reduce through the
+                # two-phase sign-compressed scheme with worker + server
+                # error feedback (ops/onebit.py), ~32x less gradient
                 # traffic; small leaves psum exactly.
-                from jax import shard_map
                 from ..ops.onebit import onebit_all_reduce
 
-                dp_axes = ("dp", "fsdp")
                 W = int(self.topo.dp_world_size)
-                ws = float(W)
-                wres_in, sres_in = residuals
 
                 def local(params, batch, wres, sres):
                     g, m = accumulate(params, batch)
@@ -576,18 +604,10 @@ class TrainingEngine:
                     m = jax.tree.map(lambda t: jax.lax.psum(t / ws, dp_axes), m)
                     return g, m, nw, ns
 
-                batch_specs = jax.tree.map(
-                    lambda _: P(None, ("dp", "fsdp")), batch)
-                rep = jax.tree.map(lambda _: P(), state.params)
-                res_spec = jax.tree.map(lambda _: P(("dp", "fsdp")),
-                                        state.params)
-                grads, msum, new_w, new_s = shard_map(
-                    local, mesh=self.topo.mesh,
-                    in_specs=(rep, batch_specs, res_spec, res_spec),
-                    out_specs=(rep,
-                               jax.tree.map(lambda _: P(), zero_metrics),
-                               res_spec, res_spec),
-                    check_vma=False)(state.params, batch, wres_in, sres_in)
+                res_spec = jax.tree.map(lambda _: P(dp_axes), state.params)
+                grads, msum, new_w, new_s = explicit_dp(
+                    local, extra_in=residuals,
+                    extra_specs=(res_spec, res_spec))
                 new_residuals = (new_w, new_s)
             elif qgz:
                 # ZeRO++ qgZ: explicit DP with int8-compressed gradient
@@ -596,11 +616,7 @@ class TrainingEngine:
                 # Assumes MEAN-semantics loss/metrics (the ModelSpec contract):
                 # per-shard values are averaged across dp; sum-semantics
                 # outputs would be rescaled by 1/dp_world.
-                from jax import shard_map
                 from ..ops.quantizer import compressed_all_reduce
-
-                dp_axes = ("dp", "fsdp")
-                ws = float(self.topo.dp_world_size)
 
                 def local(params, batch):
                     g, m = accumulate(params, batch)
@@ -610,15 +626,7 @@ class TrainingEngine:
                     m = jax.tree.map(lambda t: jax.lax.psum(t / ws, dp_axes), m)
                     return g, m
 
-                batch_specs = jax.tree.map(
-                    lambda _: P(None, ("dp", "fsdp")), batch)
-                grads, msum = shard_map(
-                    local, mesh=self.topo.mesh,
-                    in_specs=(jax.tree.map(lambda _: P(), state.params),
-                              batch_specs),
-                    out_specs=(jax.tree.map(lambda _: P(), state.params),
-                               jax.tree.map(lambda _: P(), zero_metrics)),
-                    check_vma=False)(state.params, batch)
+                grads, msum = explicit_dp(local)
             else:
                 grads, msum = accumulate(state.params, batch)
             metrics = jax.tree.map(lambda m: m / gas, msum)

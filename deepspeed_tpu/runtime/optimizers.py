@@ -87,7 +87,7 @@ def create_optimizer(cfg: OptimizerConfig, learning_rate: Schedule,
         return onebit_adam(learning_rate, weight_decay=wd,
                            freeze_step=p.get("freeze_step", 100),
                            compress_gradients=not wire_compression,
-                           **_adam_args(p))
+                           mask=weight_decay_mask, **_adam_args(p))
     raise ConfigError(f"unknown optimizer type {cfg.type!r}")
 
 
