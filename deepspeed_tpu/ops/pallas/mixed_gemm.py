@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..quantizer import pack_int4, unpack_int4
+from ..quantizer import (minifloat_decode, minifloat_encode, minifloat_max,
+                         pack_fp6, pack_int4, unpack_fp6, unpack_int4)
 from .flash_attention import _interpret, aligned_divisor
 
 
@@ -40,19 +41,20 @@ from .flash_attention import _interpret, aligned_divisor
 class QuantizedWeight:
     """Weight codes + per-(K-group, N) scales for ``x @ W``.
 
-    codes: int8, (..., K, N) for bits=8 or (..., K/2, N) for bits=4
+    codes: int8, (..., K, N) for bits=8, (..., K/2, N) for bits=4, or
+    uint8 (..., 3K/4, N) for bits=6 (FP6 e3m2, 4 K-rows per 3 byte-rows)
     scales: f32, (..., K/group, N)
     """
     codes: jax.Array
     scales: jax.Array
     bits: int
     group: int
-    k: int = 0  # true K (int4 pads odd K to even before packing)
+    k: int = 0  # true K (int4/fp6 pad K to the pack multiple)
 
     def __post_init__(self):
         if self.k == 0:
             kk = self.codes.shape[-2]
-            self.k = kk * 2 if self.bits == 4 else kk
+            self.k = {8: kk, 4: kk * 2, 6: kk * 4 // 3}[self.bits]
 
     @property
     def k_features(self) -> int:
@@ -72,12 +74,24 @@ class QuantizedWeight:
 
 def quantize_gemm_weight(w: jax.Array, bits: int = 8,
                          group: int = 256) -> QuantizedWeight:
-    """Symmetric per-(K-group, column) quantization of ``w`` (..., K, N)."""
-    assert bits in (8, 4), bits
+    """Symmetric per-(K-group, column) quantization of ``w`` (..., K, N).
+    ``bits=6`` stores FP6 e3m2 codes (reference: FP6 cuda_linear /
+    fp_quantizer) — scales map each group's absmax to the fp6 max (28)."""
+    assert bits in (8, 6, 4), bits
     *lead, K, N = w.shape
     if K % group != 0:  # shrink the group to a divisor (odd K still works)
         group = aligned_divisor(K, group, 1) or K
     wf = w.astype(jnp.float32).reshape(*lead, K // group, group, N)
+    if bits == 6:
+        scale = jnp.max(jnp.abs(wf), axis=-2, keepdims=True) / minifloat_max(3, 2)
+        scale = jnp.where(scale == 0.0, 1.0, scale)
+        codes = minifloat_encode(wf / scale, 3, 2).reshape(*lead, K, N)
+        if K % 4:  # pad zero K-rows to the 4-per-3-bytes pack multiple
+            pad = [(0, 0)] * len(lead) + [(0, (-K) % 4), (0, 0)]
+            codes = jnp.pad(codes, pad)
+        # pack along K: move K last, pack, move back
+        codes = jnp.moveaxis(pack_fp6(jnp.moveaxis(codes, -2, -1)), -1, -2)
+        return QuantizedWeight(codes, scale[..., 0, :], bits, group, k=K)
     qmax = (1 << (bits - 1)) - 1
     scale = jnp.max(jnp.abs(wf), axis=-2, keepdims=True) / qmax
     scale = jnp.where(scale == 0.0, 1.0, scale)
@@ -97,6 +111,20 @@ def _unpack_int4(c):
     return jnp.stack([lo, hi], axis=1).reshape(tk2 * 2, tn)
 
 
+def _unpack_decode_fp6(c):
+    """(3k, tn) packed bytes → (4k, tn) decoded fp6 values (in-kernel:
+    shifts + masks + an exact power-of-two bitcast, no table gather)."""
+    rows, tn = c.shape
+    b = c.astype(jnp.int32)
+    b0, b1, b2 = b[0::3], b[1::3], b[2::3]
+    c0 = b0 & 63
+    c1 = ((b0 >> 6) & 3) | ((b1 & 15) << 2)
+    c2 = ((b1 >> 4) & 15) | ((b2 & 3) << 4)
+    c3 = (b2 >> 2) & 63
+    codes = jnp.stack([c0, c1, c2, c3], axis=1).reshape(rows // 3 * 4, tn)
+    return minifloat_decode(codes, 3, 2)
+
+
 def _mixed_gemm_kernel(x_ref, c_ref, s_ref, o_ref, acc_ref, *, bits: int):
     kk = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -108,6 +136,8 @@ def _mixed_gemm_kernel(x_ref, c_ref, s_ref, o_ref, acc_ref, *, bits: int):
     c = c_ref[:]
     if bits == 4:
         c = _unpack_int4(c)
+    if bits == 6:
+        c = _unpack_decode_fp6(c)
     w = (c.astype(jnp.float32) * s_ref[0]).astype(jnp.bfloat16)
     x = x_ref[:].astype(jnp.bfloat16)
     acc_ref[:] += jax.lax.dot_general(
@@ -122,7 +152,6 @@ def _gemm_pallas(x2: jax.Array, qw: QuantizedWeight, tm: int, tn: int):
     M, K = x2.shape
     N = qw.out_features
     tk = qw.group
-    kpack = 2 if qw.bits == 4 else 1
     grid = (M // tm, N // tn, K // tk)
     kernel = functools.partial(_mixed_gemm_kernel, bits=qw.bits)
     return pl.pallas_call(
@@ -130,7 +159,9 @@ def _gemm_pallas(x2: jax.Array, qw: QuantizedWeight, tm: int, tn: int):
         grid=grid,
         in_specs=[
             pl.BlockSpec((tm, tk), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((tk // kpack, tn), lambda i, j, kk: (kk, j)),
+            # code rows per k-tile: int8 1:1, int4 2 codes/byte, fp6 4:3
+            pl.BlockSpec(({8: tk, 4: tk // 2, 6: tk // 4 * 3}[qw.bits], tn),
+                         lambda i, j, kk: (kk, j)),
             # scales get a unit middle axis so every block dim is either
             # lane-aligned or covers the full array dim (Mosaic legality)
             pl.BlockSpec((1, 1, tn), lambda i, j, kk: (kk, 0, j)),
@@ -146,6 +177,12 @@ def _gemm_pallas(x2: jax.Array, qw: QuantizedWeight, tm: int, tn: int):
 
 def dequantize_gemm_weight(qw: QuantizedWeight) -> jax.Array:
     codes = qw.codes
+    if qw.bits == 6:
+        codes = jnp.moveaxis(unpack_fp6(jnp.moveaxis(codes, -2, -1)), -1, -2)
+        vals = minifloat_decode(codes, 3, 2)[..., :qw.k_features, :]
+        *lead, K, N = vals.shape
+        v = vals.reshape(*lead, K // qw.group, qw.group, N)
+        return (v * qw.scales[..., :, None, :]).reshape(*lead, K, N)
     if qw.bits == 4:
         lo, hi = unpack_int4(codes)
         # interleave: byte row r holds K-rows 2r (lo nibble), 2r+1 (hi)
@@ -178,11 +215,13 @@ def mixed_gemm(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     pad_m = (-M) % 8
     tm = aligned_divisor(M + pad_m, 256)
     tn = aligned_divisor(N, 256, 128)
-    # int4 packs two codes per byte, so its group must be even; int8 (kpack=1)
-    # has no such constraint — gating it too would push odd-group int8 weights
-    # off the kernel path for no reason
+    # int4 packs two codes per byte (group must be even); fp6 packs 4 K-rows
+    # per 3 byte-rows (group must divide by 4, and the byte-row tile must be
+    # sublane-aligned); int8 has no pack constraint
     usable = (tm is not None and tn is not None and K % qw.group == 0
               and (qw.bits != 4 or qw.group % 2 == 0)
+              and (qw.bits != 6 or (qw.group % 4 == 0
+                                    and (qw.group // 4 * 3) % 8 == 0))
               and (qw.group % 128 == 0 or qw.group == K))
     if usable:
         xp = jnp.pad(x2, ((0, pad_m), (0, 0))) if pad_m else x2

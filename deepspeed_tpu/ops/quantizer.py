@@ -99,6 +99,132 @@ def dequantize_fp8(codes: jax.Array, scales: jax.Array, shape=None,
                                 dtype=dtype)
 
 
+# ---------------------------------------------------------------------------
+# minifloat (FP6 e3m2 / FP12 e5m6) tier — reference: csrc/fp_quantizer
+# (fp_quantize_impl.cu) and the FP6 cuda_linear W6A16 GEMM
+# ---------------------------------------------------------------------------
+
+
+def _minifloat_magnitudes(ebits: int, mbits: int) -> "jnp.ndarray":
+    """All 2^(ebits+mbits) representable magnitudes, ascending (no inf/nan —
+    the whole exponent range encodes values, like the reference's FP6)."""
+    import numpy as np
+
+    bias = (1 << (ebits - 1)) - 1
+    mags = []
+    for e in range(1 << ebits):
+        for m in range(1 << mbits):
+            if e == 0:  # subnormal
+                mags.append(m * 2.0 ** (1 - bias - mbits))
+            else:
+                mags.append((1 + m * 2.0 ** -mbits) * 2.0 ** (e - bias))
+    return jnp.asarray(np.array(mags, np.float32))
+
+
+def minifloat_max(ebits: int, mbits: int) -> float:
+    bias = (1 << (ebits - 1)) - 1
+    return float((2 - 2.0 ** -mbits) * 2.0 ** ((1 << ebits) - 1 - bias))
+
+
+def minifloat_encode(x: jax.Array, ebits: int, mbits: int) -> jax.Array:
+    """float → sign-magnitude integer codes of width 1+ebits+mbits
+    (round-to-nearest via midpoint search over the magnitude table)."""
+    mags = _minifloat_magnitudes(ebits, mbits)
+    mids = (mags[:-1] + mags[1:]) / 2.0
+    idx = jnp.searchsorted(mids, jnp.abs(x.astype(jnp.float32)))
+    sign = (x < 0).astype(jnp.int32)
+    return ((sign << (ebits + mbits)) | idx).astype(jnp.int32)
+
+
+def minifloat_decode(codes: jax.Array, ebits: int, mbits: int,
+                     dtype=jnp.float32) -> jax.Array:
+    """Arithmetic decode (no table — Pallas-friendly): sign | e | m fields."""
+    bias = (1 << (ebits - 1)) - 1
+    c = codes.astype(jnp.int32)
+    m = (c & ((1 << mbits) - 1)).astype(jnp.float32)
+    e = (c >> mbits) & ((1 << ebits) - 1)
+    sign = 1.0 - 2.0 * ((c >> (ebits + mbits)) & 1).astype(jnp.float32)
+    sub = m * 2.0 ** (1 - bias - mbits)
+    # 2^(e-bias) built from the f32 exponent field directly: jnp.exp2 goes
+    # through exp(x·ln2) in XLA and is NOT bit-exact for integer inputs,
+    # which breaks the exact-roundtrip property of the format
+    pow2 = jax.lax.bitcast_convert_type(
+        ((e - bias + 127) << 23).astype(jnp.int32), jnp.float32)
+    nrm = (1.0 + m * 2.0 ** -mbits) * pow2
+    return (sign * jnp.where(e == 0, sub, nrm)).astype(dtype)
+
+
+def pack_fp6(codes: jax.Array) -> jax.Array:
+    """(..., 4k) 6-bit codes → (..., 3k) bytes (the reference's 4:3 pack)."""
+    c = codes.astype(jnp.int32).reshape(*codes.shape[:-1], -1, 4)
+    c0, c1, c2, c3 = c[..., 0], c[..., 1], c[..., 2], c[..., 3]
+    b0 = (c0 & 63) | ((c1 & 3) << 6)
+    b1 = ((c1 >> 2) & 15) | ((c2 & 15) << 4)
+    b2 = ((c2 >> 4) & 3) | ((c3 & 63) << 2)
+    out = jnp.stack([b0, b1, b2], axis=-1)
+    return out.reshape(*codes.shape[:-1], -1).astype(jnp.uint8)
+
+
+def unpack_fp6(packed: jax.Array) -> jax.Array:
+    """(..., 3k) bytes → (..., 4k) 6-bit codes (int32)."""
+    b = packed.astype(jnp.int32).reshape(*packed.shape[:-1], -1, 3)
+    b0, b1, b2 = b[..., 0], b[..., 1], b[..., 2]
+    c0 = b0 & 63
+    c1 = ((b0 >> 6) & 3) | ((b1 & 15) << 2)
+    c2 = ((b1 >> 4) & 15) | ((b2 & 3) << 4)
+    c3 = (b2 >> 2) & 63
+    out = jnp.stack([c0, c1, c2, c3], axis=-1)
+    return out.reshape(*packed.shape[:-1], -1)
+
+
+def pack_fp12(codes: jax.Array) -> jax.Array:
+    """(..., 2k) 12-bit codes → (..., 3k) bytes."""
+    c = codes.astype(jnp.int32).reshape(*codes.shape[:-1], -1, 2)
+    c0, c1 = c[..., 0], c[..., 1]
+    out = jnp.stack([c0 & 255, ((c0 >> 8) & 15) | ((c1 & 15) << 4),
+                     (c1 >> 4) & 255], axis=-1)
+    return out.reshape(*codes.shape[:-1], -1).astype(jnp.uint8)
+
+
+def unpack_fp12(packed: jax.Array) -> jax.Array:
+    b = packed.astype(jnp.int32).reshape(*packed.shape[:-1], -1, 3)
+    b0, b1, b2 = b[..., 0], b[..., 1], b[..., 2]
+    c0 = b0 | ((b1 & 15) << 8)
+    c1 = ((b1 >> 4) & 15) | (b2 << 4)
+    out = jnp.stack([c0, c1], axis=-1)
+    return out.reshape(*packed.shape[:-1], -1)
+
+
+_MINIFLOAT_FMT = {6: (3, 2, pack_fp6, unpack_fp6, 4),
+                  12: (5, 6, pack_fp12, unpack_fp12, 2)}
+
+
+def quantize_minifloat(x: jax.Array, bits: int = 6, block_size: int = 256
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Block-scaled FP6/FP12 quantization → (packed bytes, f32 scales).
+    Scales map each block's absmax to the format max, like the fp8 path."""
+    ebits, mbits, pack, _, per = _MINIFLOAT_FMT[bits]
+    assert block_size % per == 0, (block_size, per)
+    blocks, _ = _block_reshape(x.astype(jnp.float32), block_size)
+    fmax = minifloat_max(ebits, mbits)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / fmax
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    codes = minifloat_encode(blocks / scale, ebits, mbits)
+    return pack(codes), scale[:, 0]
+
+
+def dequantize_minifloat(packed: jax.Array, scales: jax.Array, bits: int = 6,
+                         shape=None, dtype=jnp.float32) -> jax.Array:
+    ebits, mbits, _, unpack, _ = _MINIFLOAT_FMT[bits]
+    vals = minifloat_decode(unpack(packed), ebits, mbits) * scales[:, None]
+    vals = vals.reshape(-1)
+    if shape is not None:
+        import math
+
+        vals = vals[: math.prod(shape)].reshape(shape)
+    return vals.astype(dtype)
+
+
 def quantization_error(x: jax.Array, bits: int = 8, block_size: int = 256) -> jax.Array:
     codes, scales = quantize_blockwise(x, bits, block_size)
     y = dequantize_blockwise(codes, scales, bits, block_size, shape=x.shape,

@@ -273,7 +273,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         template = {"worker": engine._onebit_wres,
                     "server": engine._onebit_sres}
         shapes_match = False
-        if os.path.exists(res_path):
+        res_exists = os.path.exists(res_path)  # stat ONCE (warnings below)
+        if res_exists:
             loaded = _unflatten_like(template, _load_tree_flat(res_path))
             shapes_match = all(
                 tuple(a.shape) == tuple(b.shape)
@@ -284,7 +285,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     "onebit residual shapes in the checkpoint do not match "
                     "this engine's dp world — residuals restart from zero "
                     "(the per-worker feedback is topology-bound)")
-        elif not os.path.exists(res_path):
+        else:
             logger.warning(
                 "checkpoint has no onebit_residuals.safetensors — 1-bit "
                 "error-feedback restarts from zero (one-shot gradient-bias "

@@ -466,24 +466,32 @@ class TrainingEngine:
         W = int(self.topo.dp_world_size)
         sh = NamedSharding(self.topo.mesh, P(("dp", "fsdp")))
 
-        def mk(leaf, slot):
+        def length(leaf, slot):
             if leaf.size >= self._ONEBIT_MIN_NUMEL:
                 # worker residual (slot 0): each shard's FULL padded vector;
                 # server residual (slot 1): each shard's own chunk
-                n = residual_shapes(leaf.size, W, self._ONEBIT_BLOCK)[slot]
-            else:
-                n = 0
-            if n == 0:  # XLA rejects sharding overrides on 0-sized arrays
-                return jax.device_put(jnp.zeros((W, 0), jnp.float32), sh)
-            # allocate DIRECTLY sharded: a device_put of a materialized
-            # (W, n) buffer would stage W copies of the leaf's fp32 size on
-            # one device before resharding — OOM at exactly the scale this
-            # feature targets
-            return jax.jit(lambda: jnp.zeros((W, n), jnp.float32),
-                           out_shardings=sh)()
+                return residual_shapes(leaf.size, W, self._ONEBIT_BLOCK)[slot]
+            return 0
 
-        self._onebit_wres = jax.tree.map(lambda l: mk(l, 0), self.state.params)
-        self._onebit_sres = jax.tree.map(lambda l: mk(l, 1), self.state.params)
+        def zero_trees():
+            return tuple(
+                jax.tree.map(lambda l: jnp.zeros((W, length(l, slot)),
+                                                 jnp.float32),
+                             self.state.params)
+                for slot in (0, 1))
+
+        # ONE jitted call allocates every residual directly sharded (a
+        # device_put of materialized (W, n) buffers would stage W copies of
+        # each leaf's fp32 size on one device first — OOM at exactly the
+        # scale this feature targets; per-leaf jits would compile 2x per
+        # leaf). 0-sized leaves reject sharding overrides → device_put them.
+        shaped = jax.eval_shape(zero_trees)
+        out_sh = jax.tree.map(lambda s: None if s.shape[1] == 0 else sh,
+                              shaped)
+        wres, sres = jax.jit(zero_trees, out_shardings=out_sh)()
+        fix0 = lambda x: (jax.device_put(x, sh) if x.shape[1] == 0 else x)
+        self._onebit_wres = jax.tree.map(fix0, wres)
+        self._onebit_sres = jax.tree.map(fix0, sres)
         self._train_step_onebit = self._build_train_step(onebit=True)
 
     def _build_train_step(self, onebit: bool = False):

@@ -8,8 +8,10 @@ Functional design: the TrainingEngine owns the canonical params; the
 inference engine v2 (paged KV, continuous batching) is rebuilt-free — before
 each rollout the current params are *re-referenced* (no copy: generation
 reads the same device arrays), so the sync step the reference performs with
-LoRA fuse/unfuse + gather (:132-146) reduces to a pointer swap, with an
-optional gather when ZeRO-3 sharding must be undone for single-chip decode.
+LoRA fuse/unfuse + gather (:132-146) reduces to a pointer swap.  Under
+ZeRO-3 the rollout re-shards with the stage-1 rules: tensor-parallel axes
+STAY sharded for decode, only the fsdp partitioning is undone (full
+replication would be OOM-by-construction at the scales that need ZeRO-3).
 """
 
 from __future__ import annotations

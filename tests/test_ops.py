@@ -118,3 +118,36 @@ def test_fp8_roundtrip():
     # e4m3 has ~2 decimal digits: relative error per element < 2^-3 of absmax
     rel = float(jnp.abs(y - x).max() / jnp.abs(x).max())
     assert rel < 0.07, rel
+
+
+def test_minifloat_fp6_fp12_roundtrip():
+    """FP6 (e3m2) / FP12 (e5m6) tier (reference: csrc/fp_quantizer): every
+    representable value round-trips exactly; block quantization error is
+    bounded; packing is lossless."""
+    from deepspeed_tpu.ops.quantizer import (_minifloat_magnitudes,
+                                             dequantize_minifloat,
+                                             minifloat_decode,
+                                             minifloat_encode, pack_fp6,
+                                             pack_fp12, quantize_minifloat,
+                                             unpack_fp6, unpack_fp12)
+
+    for bits, (e, m) in ((6, (3, 2)), (12, (5, 6))):
+        mags = np.asarray(_minifloat_magnitudes(e, m))
+        vals = jnp.asarray(np.concatenate([mags, -mags]))
+        dec = minifloat_decode(minifloat_encode(vals, e, m), e, m)
+        np.testing.assert_array_equal(np.asarray(dec), np.asarray(vals))
+
+    c6 = jnp.asarray(np.random.default_rng(0).integers(0, 64, 256))
+    np.testing.assert_array_equal(np.asarray(unpack_fp6(pack_fp6(c6))),
+                                  np.asarray(c6))
+    c12 = jnp.asarray(np.random.default_rng(1).integers(0, 4096, 128))
+    np.testing.assert_array_equal(np.asarray(unpack_fp12(pack_fp12(c12))),
+                                  np.asarray(c12))
+
+    x = np.random.default_rng(2).standard_normal(4096).astype(np.float32)
+    for bits, tol in ((6, 0.1), (12, 0.005)):
+        packed, scales = quantize_minifloat(jnp.asarray(x), bits)
+        y = np.asarray(dequantize_minifloat(packed, scales, bits,
+                                            shape=x.shape))
+        rel = np.abs(y - x).mean() / np.abs(x).mean()
+        assert rel < tol, (bits, rel)
