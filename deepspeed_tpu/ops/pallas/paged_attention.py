@@ -28,23 +28,44 @@ def _interpret() -> bool:
 
 
 def _decode_attention_xla(q, k_cache, v_cache, block_tables, context_lens):
-    """Gather-based decode fallback for kernel-unfriendly shapes."""
+    """Blockwise decode fallback for kernel-unfriendly shapes: a lax.scan
+    over the block-table columns with online softmax.  Peak temp memory is
+    O(S·KV·block_size), NOT O(S·S_max) — the r3 verdict's "gather path
+    memory" bound: the old version materialized every sequence's whole
+    gathered cache at once, punishing at serving scale."""
     S, H, D = q.shape
     NB, BS, KV, _ = k_cache.shape
-    S_max = block_tables.shape[1] * BS
-    k_seq = k_cache[block_tables].reshape(S, S_max, KV, D)
-    v_seq = v_cache[block_tables].reshape(S, S_max, KV, D)
-    if KV != H:
-        rep = H // KV
-        k_seq = jnp.repeat(k_seq, rep, axis=2)
-        v_seq = jnp.repeat(v_seq, rep, axis=2)
-    scores = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
-                        k_seq.astype(jnp.float32)) / math.sqrt(D)
-    pos = jnp.arange(S_max)[None, None, :]
-    scores = jnp.where(pos < context_lens[:, None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("sht,sthd->shd", probs, v_seq.astype(jnp.float32))
-    return out.astype(q.dtype)
+    max_blocks = block_tables.shape[1]
+    rep = H // KV
+    # grouped-head layout: contracting per KV head keeps the per-step
+    # working set at O(S·KV·BS·D) — a jnp.repeat of K/V would inflate it
+    # rep× and undo the bound this fallback exists to provide
+    qf = (q.astype(jnp.float32) * (1.0 / math.sqrt(D))
+          ).reshape(S, KV, rep, D)
+
+    def block_step(carry, j):
+        acc, m, l = carry
+        blk = block_tables[:, j]                      # (S,)
+        k = k_cache[blk].astype(jnp.float32)          # (S, BS, KV, D)
+        v = v_cache[blk].astype(jnp.float32)
+        scores = jnp.einsum("skrd,stkd->skrt", qf, k)  # (S, KV, rep, BS)
+        scores = scores.reshape(S, H, BS)
+        pos = j * BS + jnp.arange(BS)[None, None, :]
+        scores = jnp.where(pos < context_lens[:, None, None], scores, -1e30)
+        m_new = jnp.maximum(m, scores.max(-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = l * alpha + p.sum(-1, keepdims=True)
+        pv = jnp.einsum("skrt,stkd->skrd", p.reshape(S, KV, rep, BS), v)
+        acc_new = acc * alpha + pv.reshape(S, H, D)
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((S, H, D), jnp.float32)
+    m0 = jnp.full((S, H, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((S, H, 1), jnp.float32)
+    (acc, _, l), _ = jax.lax.scan(block_step, (acc0, m0, l0),
+                                  jnp.arange(max_blocks))
+    return (acc / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
 
 
 def _decode_kernel(block_tables_ref, context_lens_ref,  # scalar prefetch
@@ -177,29 +198,49 @@ def paged_decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 def _prefill_attention_xla(q, k_cache, v_cache, block_tables, chunk_start,
                            chunk_len):
-    """Per-sequence gather fallback.  q: (S, Qp, H, D) — each sequence's
-    prefill chunk, rows ≥ chunk_len invalid.  Unlike the old per-TOKEN gather
-    (T, S_max, KV, D), this materializes KV once per sequence."""
+    """Blockwise prefill fallback.  q: (S, Qp, H, D) — each sequence's
+    prefill chunk, rows ≥ chunk_len invalid.  A lax.scan over block-table
+    columns with online softmax: peak temp memory is O(S·Qp·block_size),
+    never O(S·S_max) (the r3 "bound the gather path" item)."""
     S, Qp, H, D = q.shape
     NB, BS, KV, _ = k_cache.shape
-    S_max = block_tables.shape[1] * BS
-    k_seq = k_cache[block_tables].reshape(S, S_max, KV, D)
-    v_seq = v_cache[block_tables].reshape(S, S_max, KV, D)
-    if KV != H:
-        rep = H // KV
-        k_seq = jnp.repeat(k_seq, rep, axis=2)
-        v_seq = jnp.repeat(v_seq, rep, axis=2)
-    scores = jnp.einsum("sqhd,sthd->shqt", q.astype(jnp.float32),
-                        k_seq.astype(jnp.float32)) / math.sqrt(D)
-    t_pos = jnp.arange(S_max)[None, None, None, :]
-    q_pos = (chunk_start[:, None] + jnp.arange(Qp)[None, :])[:, None, :, None]
-    valid = (t_pos <= q_pos) & \
-        (t_pos < (chunk_start + chunk_len)[:, None, None, None]) & \
-        (jnp.arange(Qp)[None, None, :, None] < chunk_len[:, None, None, None])
-    scores = jnp.where(valid, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("shqt,sthd->sqhd", probs, v_seq.astype(jnp.float32))
-    return out.astype(q.dtype)
+    max_blocks = block_tables.shape[1]
+    rep = H // KV
+    # grouped heads: contract per KV head (see _decode_attention_xla)
+    qf = (q.astype(jnp.float32) * (1.0 / math.sqrt(D))
+          ).reshape(S, Qp, KV, rep, D)
+    q_pos = (chunk_start[:, None] + jnp.arange(Qp)[None, :])  # (S, Qp)
+    q_valid = jnp.arange(Qp)[None, :] < chunk_len[:, None]
+    ctx_end = chunk_start + chunk_len
+
+    def block_step(carry, j):
+        acc, m, l = carry
+        blk = block_tables[:, j]
+        k = k_cache[blk].astype(jnp.float32)          # (S, BS, KV, D)
+        v = v_cache[blk].astype(jnp.float32)
+        scores = jnp.einsum("sqkrd,stkd->skrqt", qf, k)
+        scores = scores.reshape(S, H, Qp, BS)
+        t_pos = j * BS + jnp.arange(BS)[None, None, None, :]
+        valid = (t_pos <= q_pos[:, None, :, None]) & \
+            (t_pos < ctx_end[:, None, None, None]) & \
+            q_valid[:, None, :, None]
+        scores = jnp.where(valid, scores, -1e30)
+        m_new = jnp.maximum(m, scores.max(-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = l * alpha + p.sum(-1, keepdims=True)
+        pv = jnp.einsum("skrqt,stkd->skrqd",
+                        p.reshape(S, KV, rep, Qp, BS), v)
+        acc_new = acc * alpha + pv.reshape(S, H, Qp, D)
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((S, H, Qp, D), jnp.float32)
+    m0 = jnp.full((S, H, Qp, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((S, H, Qp, 1), jnp.float32)
+    (acc, _, l), _ = jax.lax.scan(block_step, (acc0, m0, l0),
+                                  jnp.arange(max_blocks))
+    out = acc / jnp.where(l == 0.0, 1.0, l)
+    return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # (S, Qp, H, D)
 
 
 def _prefill_kernel(block_tables_ref, chunk_start_ref, chunk_len_ref,  # SMEM

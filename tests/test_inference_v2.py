@@ -322,3 +322,105 @@ def test_soa_fast_path_engages(devices, tiny_model):
     r2 = e2.generate_all(burst=4)   # burst path over the same table
     assert e1.fast_steps > 0, "SoA decode path never engaged"
     assert r1 == r2
+
+
+def _naive_paged_prefill(q, k_cache, v_cache, block_tables, chunk_start,
+                         chunk_len):
+    """Full-gather reference (the OLD fallback's math) for equivalence
+    checks only — materializes (S, S_max, ...)."""
+    import math as _math
+
+    S, Qp, H, D = q.shape
+    NB, BS, KV, _ = k_cache.shape
+    S_max = block_tables.shape[1] * BS
+    k_seq = k_cache[block_tables].reshape(S, S_max, KV, D)
+    v_seq = v_cache[block_tables].reshape(S, S_max, KV, D)
+    if KV != H:
+        rep = H // KV
+        k_seq = jnp.repeat(k_seq, rep, axis=2)
+        v_seq = jnp.repeat(v_seq, rep, axis=2)
+    scores = jnp.einsum("sqhd,sthd->shqt", q.astype(jnp.float32),
+                        k_seq.astype(jnp.float32)) / _math.sqrt(D)
+    t_pos = jnp.arange(S_max)[None, None, None, :]
+    q_pos = (chunk_start[:, None] + jnp.arange(Qp)[None, :])[:, None, :, None]
+    valid = (t_pos <= q_pos) & \
+        (t_pos < (chunk_start + chunk_len)[:, None, None, None]) & \
+        (jnp.arange(Qp)[None, None, :, None] < chunk_len[:, None, None, None])
+    scores = jnp.where(valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("shqt,sthd->sqhd", probs, v_seq.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def test_blockwise_prefill_fallback_matches_full_gather(devices):
+    """The bounded (lax.scan online-softmax) fallback must equal the full
+    per-sequence gather numerically."""
+    from deepspeed_tpu.ops.pallas.paged_attention import _prefill_attention_xla
+
+    S, Qp, H, KV, D, BS, MB = 3, 8, 4, 2, 16, 4, 6
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (S, Qp, H, D), jnp.float32)
+    k_cache = jax.random.normal(jax.random.PRNGKey(1), (32, BS, KV, D))
+    v_cache = jax.random.normal(jax.random.PRNGKey(2), (32, BS, KV, D))
+    bt = jnp.asarray(np.random.default_rng(0).permutation(32)[:S * MB]
+                     .reshape(S, MB).astype(np.int32))
+    cs = jnp.asarray([0, 5, 11], jnp.int32)
+    cl = jnp.asarray([8, 3, 6], jnp.int32)
+    got = _prefill_attention_xla(q, k_cache, v_cache, bt, cs, cl)
+    ref = _naive_paged_prefill(q, k_cache, v_cache, bt, cs, cl)
+    # compare only valid rows (padding rows emit zeros vs garbage)
+    for s in range(S):
+        n = int(cl[s])
+        np.testing.assert_allclose(np.asarray(got[s, :n]),
+                                   np.asarray(ref[s, :n]),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_blockwise_decode_fallback_matches_reference(devices):
+    from deepspeed_tpu.ops.pallas.paged_attention import (
+        _decode_attention_xla)
+
+    S, H, KV, D, BS, MB = 4, 8, 2, 16, 8, 4
+    q = jax.random.normal(jax.random.PRNGKey(0), (S, H, D), jnp.float32)
+    k_cache = jax.random.normal(jax.random.PRNGKey(1), (32, BS, KV, D))
+    v_cache = jax.random.normal(jax.random.PRNGKey(2), (32, BS, KV, D))
+    bt = jnp.asarray(np.random.default_rng(0).permutation(32)[:S * MB]
+                     .reshape(S, MB).astype(np.int32))
+    ctx = jnp.asarray([5, 17, 32, 1], jnp.int32)
+    from deepspeed_tpu.inference.v2.engine import ragged_attention_xla
+
+    got = _decode_attention_xla(q, k_cache, v_cache, bt, ctx)
+    ref = ragged_attention_xla(q, k_cache, v_cache, bt, ctx,
+                               jnp.arange(S, dtype=jnp.int32), ctx - 1,
+                               None, BS)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_serving_scale_fallback_memory_bounded(devices):
+    """Serving scale (16 seqs x 4096 ctx): the kernel-unfriendly-shape
+    fallback's compiled temp memory must stay O(S·Qp·block), nowhere near
+    the old full gather's O(S·S_max) working set (r3 verdict weak #6)."""
+    from deepspeed_tpu.ops.pallas.paged_attention import (
+        _decode_attention_xla, _prefill_attention_xla)
+
+    # GQA (H != KV): the grouped einsum must hold the bound without a
+    # rep-x jnp.repeat of K/V inflating the per-step working set
+    S, Qp, H, KV, D, BS, MB, NB = 16, 256, 8, 2, 64, 32, 128, 2048
+    q = jnp.zeros((S, Qp, H, D), jnp.float32)
+    kc = jnp.zeros((NB, BS, KV, D), jnp.float32)
+    bt = jnp.zeros((S, MB), jnp.int32)
+    z = jnp.zeros((S,), jnp.int32)
+    ma = jax.jit(_prefill_attention_xla).lower(
+        q, kc, kc, bt, z, z).compile().memory_analysis()
+    old_working_set = 2 * S * MB * BS * H * D * 4 + S * H * Qp * MB * BS * 4
+    assert ma.temp_size_in_bytes < old_working_set / 8, (
+        f"prefill fallback temp {ma.temp_size_in_bytes/2**20:.0f} MiB — "
+        f"not bounded (old gather ~{old_working_set/2**20:.0f} MiB)")
+
+    qd = jnp.zeros((S, H, D), jnp.float32)
+    mad = jax.jit(_decode_attention_xla).lower(
+        qd, kc, kc, bt, z).compile().memory_analysis()
+    old_decode = 2 * S * MB * BS * H * D * 4
+    assert mad.temp_size_in_bytes < old_decode / 8, (
+        f"decode fallback temp {mad.temp_size_in_bytes/2**20:.0f} MiB")
