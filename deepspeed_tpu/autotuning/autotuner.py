@@ -148,6 +148,137 @@ class Autotuner:
         return best.config_overrides, self.experiments
 
 
+class ModelBasedAutotuner(Autotuner):
+    """Cost-model-guided search (reference:
+    ``autotuning/tuner/model_based_tuner.py`` — there an XGBoost cost model
+    ranks unexplored configs; here a ridge-regressed log-linear model, i.e.
+    multiplicative per-axis effects, which is exactly the structure of
+    throughput over zero-stage/micro-batch/remat axes).
+
+    Procedure:
+
+    1. **seed** with a one-factor-at-a-time design: a center config plus
+       one variant per axis LEVEL — every level gets measured at least
+       once, at ``1 + Σ(len(axis)-1)`` experiments instead of the grid's
+       ``Π len(axis)``;
+    2. **fit** ridge regression on log(throughput) over one-hot levels;
+    3. **probe** unmeasured candidates in predicted-best order until
+       ``tuner_early_stopping`` consecutive probes fail to beat the
+       incumbent (failed candidates count — they are information too).
+
+    Returns the best MEASURED config (predictions only order the search,
+    they never pick the winner)."""
+
+    def _score(self, e: Experiment) -> float:
+        """The maximized objective, honoring ``cfg.metric`` — fitting and
+        early-stopping on throughput while the final pick used latency
+        would let the search stop before the latency-best config is ever
+        measured."""
+        if self.cfg.metric == "latency":
+            return 1.0 / e.step_time_s
+        return e.throughput
+
+    def _featurize(self, ov: Dict[str, Any]) -> "np.ndarray":
+        feats = [1.0]
+        for key in sorted(self.space):
+            levels = list(self.space[key])
+            # one-hot with the first level as baseline
+            feats.extend(1.0 if ov[key] == lv else 0.0
+                         for lv in levels[1:])
+        return np.array(feats, np.float64)
+
+    def _fit_predict(self, candidates: List[Dict[str, Any]],
+                     lam: float = 1e-3) -> List[float]:
+        ok = [e for e in self.experiments if e.ok]
+        X = np.stack([self._featurize(e.config_overrides) for e in ok])
+        y = np.log(np.array([self._score(e) for e in ok], np.float64))
+        d = X.shape[1]
+        theta = np.linalg.solve(X.T @ X + lam * np.eye(d), X.T @ y)
+        return [float(self._featurize(c) @ theta) for c in candidates]
+
+    def tune(self) -> Tuple[Dict[str, Any], List[Experiment]]:
+        all_cands = self._candidates()
+        center = {k: v[0] for k, v in self.space.items()}
+        seeds = [center] + [
+            dict(center, **{key: lv})
+            for key in sorted(self.space)
+            for lv in list(self.space[key])[1:]
+        ]
+        for ov in seeds:
+            self._run(ov)
+        if not any(e.ok for e in self.experiments):
+            raise RuntimeError("autotuning: every seed candidate failed")
+
+        def measured(ov):
+            return any(e.config_overrides == ov for e in self.experiments)
+
+        patience = max(1, self.cfg.tuner_early_stopping)
+        strikes = 0
+        while strikes < patience:
+            remaining = [c for c in all_cands if not measured(c)]
+            if not remaining:
+                break
+            preds = self._fit_predict(remaining)
+            ov = remaining[int(np.argmax(preds))]
+            incumbent = max((self._score(e) for e in self.experiments
+                             if e.ok), default=0.0)
+            exp = self._run(ov)
+            if exp.ok and self._score(exp) > incumbent:
+                strikes = 0
+            else:
+                strikes += 1
+        ok = [e for e in self.experiments if e.ok]
+        if self.cfg.metric == "latency":
+            best = min(ok, key=lambda e: e.step_time_s)
+        else:
+            best = max(ok, key=lambda e: e.throughput)
+        log_dist(f"autotune(model_based) best: {best.config_overrides} "
+                 f"({best.throughput:.1f} samples/s, "
+                 f"{len(self.experiments)}/{len(all_cands)} configs measured)")
+        return best.config_overrides, self.experiments
+
+
+class RandomAutotuner(ModelBasedAutotuner):
+    """Shuffled search with early stopping (reference
+    ``tuner/random_tuner.py``): measure candidates in random order, stop
+    after ``tuner_early_stopping`` consecutive failures to improve — cheap
+    when the grid is large and effects are monotone-ish.  Shares the
+    metric-aware ``_score`` with the model-based tuner."""
+
+    def tune(self) -> Tuple[Dict[str, Any], List[Experiment]]:
+        cands = self._candidates()
+        np.random.default_rng(self.cfg.mp_size + 42).shuffle(cands)
+        patience = max(1, self.cfg.tuner_early_stopping)
+        strikes = 0
+        for ov in cands:
+            incumbent = max((self._score(e) for e in self.experiments
+                             if e.ok), default=0.0)
+            exp = self._run(ov)
+            if exp.ok and self._score(exp) > incumbent:
+                strikes = 0
+            elif self.experiments and any(e.ok for e in self.experiments):
+                strikes += 1
+                if strikes >= patience:
+                    break
+        ok = [e for e in self.experiments if e.ok]
+        if not ok:
+            raise RuntimeError("autotuning: every candidate failed")
+        best = max(ok, key=self._score)
+        log_dist(f"autotune(random) best: {best.config_overrides} "
+                 f"({len(self.experiments)}/{len(cands)} measured)")
+        return best.config_overrides, self.experiments
+
+
+def make_tuner(cfg: AutotuningConfig, *args, **kwargs) -> Autotuner:
+    """Dispatch on ``autotuning.tuner_type`` (reference ``tuner/__init__``:
+    gridsearch | random | model_based)."""
+    if cfg.tuner_type == "model_based":
+        return ModelBasedAutotuner(cfg, *args, **kwargs)
+    if cfg.tuner_type == "random":
+        return RandomAutotuner(cfg, *args, **kwargs)
+    return Autotuner(cfg, *args, **kwargs)
+
+
 # ---------------------------------------------------------------------------
 # subprocess mode (reference scheduler.py equivalent)
 # ---------------------------------------------------------------------------

@@ -62,6 +62,10 @@ class TransformerConfig:
     # 'capacity' (GShard buckets; the ep all-to-all path) | 'dropless'
     # (grouped-GEMM, no token dropping — moe/dropless.py)
     moe_routing: str = "capacity"
+    # PR-MoE (reference deepspeed/moe/layer.py:17 use_residual): a dense
+    # "shared expert" MLP runs beside the MoE and a learned 2-way softmax
+    # coefficient mixes the two outputs per token
+    moe_use_residual: bool = False
     # dtypes
     dtype: str = "bfloat16"  # compute dtype
     param_dtype: str = "float32"  # master weights
@@ -129,6 +133,9 @@ PRESETS: Dict[str, Dict[str, Any]] = {
                  num_heads=4, max_seq_len=128),
     "tiny-moe": dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
                      num_heads=4, max_seq_len=128, num_experts=4, moe_top_k=2),
+    "tiny-prmoe": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_layers=2, num_heads=4, max_seq_len=128,
+                       num_experts=4, moe_top_k=2, moe_use_residual=True),
 }
 
 
@@ -182,6 +189,13 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
         }
         if cfg.activation != "silu":
             del layer["moe"]["w_gate"]
+        if cfg.moe_use_residual:  # PR-MoE shared expert + mixing coefficient
+            rk = jax.random.split(keys[11], 4)  # keys[4] feeds the router
+            layer["moe"]["res_w_in"] = _dense_init(rk[0], (L, h, f), h, pd)
+            layer["moe"]["res_w_out"] = _dense_init(rk[1], (L, f, h), f, pd)
+            if cfg.activation == "silu":
+                layer["moe"]["res_w_gate"] = _dense_init(rk[2], (L, h, f), h, pd)
+            layer["moe"]["coef"] = _dense_init(rk[3], (L, h, 2), h, pd)
     else:
         mlp = {
             "w_in": _dense_init(keys[5], (L, h, f), h, pd),
@@ -233,6 +247,12 @@ def param_axes(cfg: TransformerConfig, params: Optional[Dict[str, Any]] = None
         }
         if cfg.activation == "silu":
             moe["w_gate"] = ("layers", "expert", "embed", "mlp")
+        if cfg.moe_use_residual:
+            moe["res_w_in"] = ("layers", "embed", "mlp")
+            moe["res_w_out"] = ("layers", "mlp", "embed")
+            if cfg.activation == "silu":
+                moe["res_w_gate"] = ("layers", "embed", "mlp")
+            moe["coef"] = ("layers", "embed", None)
         layer["moe"] = moe
     else:
         mlp = {"w_in": ("layers", "embed", "mlp"), "w_out": ("layers", "mlp", "embed")}

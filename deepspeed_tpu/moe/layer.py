@@ -105,7 +105,10 @@ def moe_block_with_losses(x: jax.Array, p: Dict[str, Any], cfg
     if getattr(cfg, "moe_routing", "capacity") == "dropless":
         from .dropless import dropless_moe_block_with_losses
 
-        return dropless_moe_block_with_losses(x, p, cfg)
+        y, aux, z = dropless_moe_block_with_losses(x, p, cfg)
+        if getattr(cfg, "moe_use_residual", False):
+            y = _prmoe_combine(x, y, p, cfg)
+        return y, aux, z
     dt = x.dtype
     E = cfg.num_experts
     logits = x.astype(jnp.float32) @ p["router"].astype(jnp.float32)
@@ -122,4 +125,27 @@ def moe_block_with_losses(x: jax.Array, p: Dict[str, Any], cfg
         hmid = jax.nn.gelu(jnp.einsum("ebch,ehf->ebcf", xe, w_in), approximate=True)
     ye = jnp.einsum("ebcf,efh->ebch", hmid, w_out)
     y = jnp.einsum("bsec,ebch->bsh", comb, ye)
+    if getattr(cfg, "moe_use_residual", False):
+        y = _prmoe_combine(x, y, p, cfg)
     return y, gate.aux_loss, gate.z_loss
+
+
+def _prmoe_combine(x: jax.Array, moe_out: jax.Array, p: Dict[str, Any],
+                   cfg) -> jax.Array:
+    """PR-MoE / residual MoE (reference ``deepspeed/moe/layer.py:17``
+    ``use_residual``): a dense "shared expert" MLP runs on every token and a
+    learned per-token 2-way softmax coefficient mixes it with the sparse MoE
+    output — ``out = mlp·c₀ + moe·c₁``.  Every token gets the shared
+    expert's capacity even when the router drops it."""
+    dt = x.dtype
+    xin = x.astype(dt)
+    if "res_w_gate" in p:
+        hmid = jax.nn.silu(xin @ p["res_w_gate"].astype(dt)) * \
+            (xin @ p["res_w_in"].astype(dt))
+    else:
+        hmid = jax.nn.gelu(xin @ p["res_w_in"].astype(dt), approximate=True)
+    mlp_out = hmid @ p["res_w_out"].astype(dt)
+    coef = jax.nn.softmax(
+        x.astype(jnp.float32) @ p["coef"].astype(jnp.float32), axis=-1)
+    return (mlp_out * coef[..., 0:1].astype(dt)
+            + moe_out * coef[..., 1:2].astype(dt))

@@ -65,7 +65,11 @@ def _decode_attention_xla(q, k_cache, v_cache, block_tables, context_lens):
     l0 = jnp.zeros((S, H, 1), jnp.float32)
     (acc, _, l), _ = jax.lax.scan(block_step, (acc0, m0, l0),
                                   jnp.arange(max_blocks))
-    return (acc / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
+    out = acc / jnp.where(l == 0.0, 1.0, l)
+    # a fully-masked row has every p = exp(-1e30 - -1e30) = 1, so it holds
+    # the MEAN of gathered V, not zeros — zero ctx=0 rows explicitly
+    out = jnp.where(context_lens[:, None, None] > 0, out, 0.0)
+    return out.astype(q.dtype)
 
 
 def _decode_kernel(block_tables_ref, context_lens_ref,  # scalar prefetch
@@ -240,7 +244,10 @@ def _prefill_attention_xla(q, k_cache, v_cache, block_tables, chunk_start,
     (acc, _, l), _ = jax.lax.scan(block_step, (acc0, m0, l0),
                                   jnp.arange(max_blocks))
     out = acc / jnp.where(l == 0.0, 1.0, l)
-    return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # (S, Qp, H, D)
+    out = jnp.moveaxis(out, 1, 2)  # (S, Qp, H, D)
+    # fully-masked (padding) q rows held p = 1 everywhere → the mean of
+    # gathered V, not zeros; zero them explicitly so callers can rely on it
+    return jnp.where(q_valid[:, :, None, None], out, 0.0).astype(q.dtype)
 
 
 def _prefill_kernel(block_tables_ref, chunk_start_ref, chunk_len_ref,  # SMEM

@@ -407,6 +407,10 @@ class DeepSpeedTPUConfig(DSConfigModel):
     steps_per_print: int = 10
     wall_clock_breakdown: bool = False
     dump_state: bool = False
+    # reference engine.py:1346 is_sanity_checks_enabled + the AutoEP payload
+    # digests (moe/ep_tp_dispatch.py:210): per-step NaN/inf checks on loss
+    # and grad norm, plus periodic cross-shard replica-consistency digests
+    sanity_checks: bool = False
     prescale_gradients: bool = False
     gradient_predivide_factor: float = 1.0
     gradient_clipping: float = 0.0

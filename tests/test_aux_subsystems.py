@@ -395,3 +395,74 @@ def test_hf_auto_unresolvable_raises(devices):
           "flops_profiler": {"output_file": "auto"}}  # no source for this
     with pytest.raises(ValueError):
         resolve_auto_config(ds, {"learning_rate": 1e-4})
+
+
+def test_model_based_tuner_fewer_experiments_same_winner(monkeypatch):
+    """Reference: autotuning/tuner/model_based_tuner.py — the cost-model
+    tuner must pick the SAME config as exhaustive grid search on the
+    example ladder while measuring fewer candidates."""
+    from deepspeed_tpu.autotuning.autotuner import (Autotuner, Experiment,
+                                                    ModelBasedAutotuner,
+                                                    make_tuner)
+    from deepspeed_tpu.runtime.config import AutotuningConfig
+
+    space = {"zero_stage": [0, 1, 2, 3], "micro_batch": [1, 2, 4, 8],
+             "remat_policy": ["none", "full"]}
+
+    # synthetic ladder: throughput = per-axis multiplicative effects with a
+    # mild interaction; best = stage 1, micro 8, remat none
+    def fake_throughput(ov):
+        stage = {0: 1.0, 1: 1.3, 2: 1.1, 3: 0.8}[ov["zero_stage"]]
+        mb = ov["micro_batch"] ** 0.7
+        remat = {"none": 1.0, "full": 0.85}[ov["remat_policy"]]
+        inter = 0.9 if (ov["zero_stage"] == 3 and ov["micro_batch"] == 8) \
+            else 1.0
+        return 100.0 * stage * mb * remat * inter
+
+    def fake_measure(self, overrides):
+        thr = fake_throughput(overrides)
+        return Experiment(config_overrides=dict(overrides),
+                          throughput=thr, step_time_s=1.0 / thr)
+
+    monkeypatch.setattr(Autotuner, "_measure", fake_measure)
+
+    cfg = AutotuningConfig(enabled=True, fast=False,
+                           tuner_type="model_based", tuner_early_stopping=3)
+    grid = make_tuner(AutotuningConfig(enabled=True, fast=False),
+                      None, None, space=space)
+    best_grid, exps_grid = grid.tune()
+
+    model = make_tuner(cfg, None, None, space=space)
+    assert isinstance(model, ModelBasedAutotuner)
+    best_model, exps_model = model.tune()
+
+    assert best_model == best_grid == {
+        "zero_stage": 1, "micro_batch": 8, "remat_policy": "none"}
+    assert len(exps_grid) == 32
+    assert len(exps_model) < len(exps_grid) / 2, (
+        f"model-based used {len(exps_model)} of {len(exps_grid)} grid runs")
+
+
+def test_model_based_tuner_survives_failed_candidates(monkeypatch):
+    """OOM-style failures during seeding or probing are data, not crashes."""
+    from deepspeed_tpu.autotuning.autotuner import (Autotuner, Experiment,
+                                                    ModelBasedAutotuner)
+    from deepspeed_tpu.runtime.config import AutotuningConfig
+
+    space = {"zero_stage": [0, 1], "micro_batch": [1, 2, 4]}
+
+    def fake_measure(self, overrides):
+        if overrides["micro_batch"] == 4:  # "OOM"
+            return Experiment(config_overrides=dict(overrides),
+                              error="RESOURCE_EXHAUSTED")
+        thr = 10.0 * overrides["micro_batch"] + overrides["zero_stage"]
+        return Experiment(config_overrides=dict(overrides),
+                          throughput=thr, step_time_s=1.0 / thr)
+
+    monkeypatch.setattr(Autotuner, "_measure", fake_measure)
+    tuner = ModelBasedAutotuner(
+        AutotuningConfig(enabled=True, tuner_type="model_based",
+                         tuner_early_stopping=2), None, None, space=space)
+    best, exps = tuner.tune()
+    assert best == {"zero_stage": 1, "micro_batch": 2}
+    assert any(not e.ok for e in exps)

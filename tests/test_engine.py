@@ -213,3 +213,63 @@ def test_qwz_rejects_bad_configs(devices):
         deepspeed_tpu.initialize(model=tiny_lm_spec(), config=dict(
             BASE, zero_optimization={"stage": 2,
                                      "zero_quantized_weights": True}))
+
+
+def test_sanity_checks_mode(devices):
+    """sanity_checks (reference engine.py:1346): clean training passes; a
+    poisoned batch raises instead of training on garbage."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    spec = tiny_lm_spec()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "sanity_checks": True,
+        "steps_per_print": 2,
+    })
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    for _ in range(4):  # crosses a digest-check step; must stay silent
+        engine.train_batch(batch)
+
+    # poison the params so the next loss is NaN → loud failure
+    engine.state = dataclasses.replace(
+        engine.state,
+        params=jax.tree.map(lambda x: x * jnp.nan, engine.state.params))
+    with pytest.raises(RuntimeError, match="sanity_checks: non-finite"):
+        engine.train_batch(batch)
+
+
+def test_sanity_checks_detect_replica_divergence(devices):
+    """The cross-shard digest check must flag a replicated leaf whose
+    shards disagree (simulated device desync)."""
+    import jax
+
+    spec = tiny_lm_spec()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "sanity_checks": True,
+        "steps_per_print": 1,
+    })
+    assert engine._replica_consistency_violations() == []
+    # forge a desynced replicated array: same sharding, different shard data
+    leaf = engine.state.params["embed"]["tokens"]
+    devs = leaf.sharding.device_set
+    if len(devs) < 2:
+        return  # single device: nothing to diverge
+    parts = []
+    for i, d in enumerate(sorted(devs, key=lambda d: d.id)):
+        arr = np.asarray(jax.device_get(leaf))
+        if i == len(devs) - 1:
+            arr = arr + 1.0  # the desync
+        parts.append(jax.device_put(arr, d))
+    forged = jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, parts)
+    engine.state.params["embed"]["tokens"] = forged
+    assert engine._replica_consistency_violations() != []
