@@ -1,12 +1,15 @@
 from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder,
                               best_fitting_dtype, make_builder)
-from .data_analyzer import DataAnalyzer
+from .data_analyzer import (DataAnalyzer,
+                            DistributedDataAnalyzer,
+                            samples_up_to_difficulty)
 from .variable_batch_size_and_lr import (VariableBatchConfig,
                                          batch_by_token_budget,
                                          lr_scale_for_batch)
 
 __all__ = [
     "MMapIndexedDataset", "MMapIndexedDatasetBuilder", "best_fitting_dtype",
-    "make_builder", "DataAnalyzer", "VariableBatchConfig",
+    "make_builder", "DataAnalyzer", "DistributedDataAnalyzer",
+    "samples_up_to_difficulty", "VariableBatchConfig",
     "batch_by_token_budget", "lr_scale_for_batch",
 ]

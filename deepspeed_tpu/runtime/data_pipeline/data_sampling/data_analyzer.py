@@ -103,38 +103,250 @@ class DataAnalyzer:
     def run_reduce(self) -> Dict[str, str]:
         """Merge shards into the final index files; returns metric → path
         of the sample_to_metric (or accumulated) artifact."""
-        out: Dict[str, str] = {}
-        n = len(self.dataset)
-        for m in self.metric_fns:
-            shards = [np.load(self._shard_path(m, s))
-                      for s in range(self.num_workers)]
-            merged = np.concatenate(shards) if shards else np.empty(0)
-            if len(merged) != n:
-                raise ValueError(
-                    f"metric {m!r}: merged length {len(merged)} != dataset "
-                    f"size {n} (stale shards from a different run?)")
-            kind = self.metric_types.get(m, "single_value_per_sample")
-            if kind == "accumulate_value_over_samples":
-                path = os.path.join(self.save_path, f"{m}_accumulated.npy")
-                np.save(path, merged.sum())
-                out[m] = path
-                continue
-            s2m = os.path.join(self.save_path, f"{m}_sample_to_metric.npy")
-            np.save(s2m, merged)
-            # CSR: metric value → sample ids
-            order = np.argsort(merged, kind="stable")
-            svals = merged[order]
-            uniq, starts = np.unique(svals, return_index=True)
-            row_ptr = np.concatenate([starts, [len(svals)]])
-            np.savez(os.path.join(self.save_path,
-                                  f"{m}_metric_to_sample.npz"),
-                     values=uniq, row_ptr=row_ptr, sample_ids=order)
-            out[m] = s2m
-        return out
+        return merge_and_write(
+            self.save_path, len(self.dataset), self.metric_fns,
+            self.metric_types,
+            lambda m: [self._shard_path(m, s)
+                       for s in range(self.num_workers)])
 
     def run(self) -> Dict[str, str]:
         self.run_map()
         return self.run_reduce()
+
+
+def merge_and_write(save_path: str, n: int, metric_fns, metric_types,
+                    paths_for_metric) -> Dict[str, str]:
+    """Shared reduce: load each metric's shard files in order, validate the
+    merged length, and write the final index files — ONE copy of the
+    merge/validate/dispatch logic for both analyzers."""
+    out: Dict[str, str] = {}
+    for m in metric_fns:
+        paths = paths_for_metric(m)
+        parts = [np.load(p) for p in paths]
+        merged = np.concatenate(parts) if parts else np.empty(0)
+        if len(merged) != n:
+            raise ValueError(
+                f"metric {m!r}: merged length {len(merged)} != dataset "
+                f"size {n} (stale shards from a different run?)")
+        kind = metric_types.get(m, "single_value_per_sample")
+        out[m] = write_final_indexes(save_path, m, merged, kind)
+    return out
+
+
+def write_final_indexes(save_path: str, metric: str, merged: np.ndarray,
+                        kind: str = "single_value_per_sample") -> str:
+    """Write a metric's final artifacts from the fully-merged (N,) values —
+    shared by the thread analyzer and the distributed one so both produce
+    byte-identical index files."""
+    if kind == "accumulate_value_over_samples":
+        path = os.path.join(save_path, f"{metric}_accumulated.npy")
+        np.save(path, merged.sum())
+        return path
+    s2m = os.path.join(save_path, f"{metric}_sample_to_metric.npy")
+    np.save(s2m, merged)
+    # CSR: metric value → sample ids
+    order = np.argsort(merged, kind="stable")
+    svals = merged[order]
+    uniq, starts = np.unique(svals, return_index=True)
+    row_ptr = np.concatenate([starts, [len(svals)]])
+    np.savez(os.path.join(save_path, f"{metric}_metric_to_sample.npz"),
+             values=uniq, row_ptr=row_ptr, sample_ids=order)
+    return s2m
+
+
+class DistributedDataAnalyzer:
+    """Map-reduce dataset analysis ACROSS PROCESSES/HOSTS (reference:
+    ``data_sampling/data_analyzer.py:457 DistributedDataAnalyzer`` — there
+    each torch.distributed rank analyzes its slice and rank 0 merges).
+
+    Coordination is the filesystem (the save_path is shared storage on a
+    pod, like the reference's output dir): rank r writes
+    ``{metric}_rank{r}.npy`` + a ``.done`` sentinel; the reducer waits for
+    every sentinel, merges in rank order, and emits the SAME index files as
+    :class:`DataAnalyzer` (via :func:`write_final_indexes`).  No collective
+    library is needed — analysis is host-side numpy and the launcher
+    (``dstpu``) already provides RANK/WORLD_SIZE.
+
+    ``spawn_local(n)`` runs n worker subprocesses on this host from a
+    ``"module:function"`` dataset factory — the reference's
+    multiprocessing map phase, GIL-free.
+    """
+
+    def __init__(self, dataset, metric_fns: Dict[str, MetricFn],
+                 save_path: str, rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 metric_types: Optional[Dict[str, str]] = None):
+        self.dataset = dataset
+        self.metric_fns = dict(metric_fns)
+        self.save_path = save_path
+        self.rank = int(os.environ.get("RANK", 0)) if rank is None else rank
+        self.world_size = (int(os.environ.get("WORLD_SIZE", 1))
+                           if world_size is None else world_size)
+        self.metric_types = dict(metric_types or {})
+        os.makedirs(save_path, exist_ok=True)
+
+    def _rank_path(self, metric: str, rank: int) -> str:
+        return os.path.join(self.save_path, f"{metric}_rank{rank}.npy")
+
+    def _sentinel(self, rank: int) -> str:
+        return os.path.join(self.save_path, f"rank{rank}.done")
+
+    def _bounds(self, n: int) -> np.ndarray:
+        return np.linspace(0, n, self.world_size + 1, dtype=np.int64)
+
+    def _expected_sentinel(self, rank: int) -> Dict:
+        bounds = self._bounds(len(self.dataset))
+        return {"lo": int(bounds[rank]), "hi": int(bounds[rank + 1]),
+                "world_size": self.world_size,
+                "metrics": sorted(self.metric_fns)}
+
+    def run_map_local(self) -> None:
+        """Analyze THIS rank's contiguous slice and publish it."""
+        n = len(self.dataset)
+        lo, hi = (int(b) for b in self._bounds(n)[self.rank:self.rank + 2])
+        # a STALE sentinel from a previous run in this save_path would let
+        # a concurrent reducer fire while we are still rewriting the rank
+        # files — remove it before touching anything
+        try:
+            os.unlink(self._sentinel(self.rank))
+        except FileNotFoundError:
+            pass
+        vals = {m: np.empty(hi - lo, np.float64) for m in self.metric_fns}
+        for i in range(lo, hi):
+            sample = np.asarray(self.dataset[i])
+            for m, fn in self.metric_fns.items():
+                vals[m][i - lo] = fn(sample)
+        for m in self.metric_fns:
+            np.save(self._rank_path(m, self.rank), vals[m])
+        # sentinel written LAST: its existence implies complete rank files
+        with open(self._sentinel(self.rank), "w") as f:
+            json.dump(self._expected_sentinel(self.rank), f)
+
+    def wait_for_workers(self, timeout_s: float = 600.0,
+                         poll_s: float = 0.5) -> None:
+        """Block until every rank's sentinel exists AND describes this run
+        (same bounds/world/metrics) — a leftover sentinel from a different
+        configuration is the stale-run hazard the thread analyzer's
+        manifest guards against."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            missing, stale = [], []
+            for r in range(self.world_size):
+                path = self._sentinel(r)
+                if not os.path.exists(path):
+                    missing.append(r)
+                    continue
+                try:
+                    with open(path) as f:
+                        seen = json.load(f)
+                except (json.JSONDecodeError, OSError):
+                    missing.append(r)  # torn write: keep waiting
+                    continue
+                if seen != self._expected_sentinel(r):
+                    stale.append((r, seen))
+            if stale:
+                raise ValueError(
+                    f"distributed analysis: sentinels in {self.save_path} "
+                    f"describe a DIFFERENT run {stale[:2]} — use a fresh "
+                    f"save_path or rerun the map phase everywhere")
+            if not missing:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"distributed analysis: ranks {missing} never finished "
+                    f"(no sentinel in {self.save_path} after {timeout_s}s)")
+            time.sleep(poll_s)
+
+    def run_reduce(self, timeout_s: float = 600.0) -> Dict[str, str]:
+        """Merge every rank's values (rank order = sample order) into the
+        final index files.  Any rank may run this; rank 0 does by
+        convention.  Blocks until all sentinels exist."""
+        self.wait_for_workers(timeout_s)
+        return merge_and_write(
+            self.save_path, len(self.dataset), self.metric_fns,
+            self.metric_types,
+            lambda m: [self._rank_path(m, r)
+                       for r in range(self.world_size)])
+
+    def run(self, timeout_s: float = 600.0) -> Optional[Dict[str, str]]:
+        """Reference surface: every rank maps; rank 0 reduces and returns
+        the artifact paths (other ranks return None)."""
+        self.run_map_local()
+        if self.rank == 0:
+            return self.run_reduce(timeout_s)
+        return None
+
+    # -- single-host convenience: subprocess map phase -----------------
+    @staticmethod
+    def spawn_local(dataset_factory: str, metric_fns_factory: str,
+                    save_path: str, num_procs: int,
+                    timeout_s: float = 600.0,
+                    metric_types: Optional[Dict[str, str]] = None
+                    ) -> Dict[str, str]:
+        """Run the map phase as ``num_procs`` subprocesses of this host
+        (GIL-free) and reduce in-process.  Factories are
+        ``"module:function"`` strings; the dataset factory returns the
+        dataset, the metric factory returns {name: fn}."""
+        import subprocess
+        import sys
+
+        cmd_tail = ["--dataset", dataset_factory, "--metrics",
+                    metric_fns_factory, "--save-path", save_path]
+        if metric_types:
+            cmd_tail += ["--metric-types", json.dumps(metric_types)]
+        procs = []
+        try:
+            for r in range(num_procs):
+                env = dict(os.environ, RANK=str(r),
+                           WORLD_SIZE=str(num_procs), JAX_PLATFORMS="cpu")
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m",
+                     "deepspeed_tpu.runtime.data_pipeline.data_sampling"
+                     ".data_analyzer", *cmd_tail],
+                    env=env))
+            rcs = [p.wait(timeout=timeout_s) for p in procs]
+        finally:
+            for p in procs:  # a hung worker must not outlive the sweep and
+                if p.poll() is None:  # write into a retried save_path
+                    p.kill()
+        if any(rcs):
+            raise RuntimeError(f"analyzer workers failed: rcs={rcs}")
+        dataset = _resolve_factory(dataset_factory)()
+        metrics = _resolve_factory(metric_fns_factory)()
+        return DistributedDataAnalyzer(
+            dataset, metrics, save_path, rank=0, world_size=num_procs,
+            metric_types=metric_types).run_reduce(timeout_s)
+
+
+def _resolve_factory(spec: str):
+    import importlib
+
+    module, _, fn = spec.partition(":")
+    return getattr(importlib.import_module(module), fn)
+
+
+def _worker_main() -> int:
+    """CLI worker for :meth:`DistributedDataAnalyzer.spawn_local` (and for
+    launcher-driven multi-host analysis: ``dstpu ... -m ...data_analyzer``)."""
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", required=True,
+                    help="module:function returning the dataset")
+    ap.add_argument("--metrics", required=True,
+                    help="module:function returning {name: metric_fn}")
+    ap.add_argument("--save-path", required=True)
+    ap.add_argument("--metric-types", default=None,
+                    help="JSON {metric: kind} (kinds as in DataAnalyzer)")
+    args = ap.parse_args()
+    dataset = _resolve_factory(args.dataset)()
+    metrics = _resolve_factory(args.metrics)()
+    types = json.loads(args.metric_types) if args.metric_types else None
+    DistributedDataAnalyzer(dataset, metrics, args.save_path,
+                            metric_types=types).run_map_local()
+    return 0
 
 
 def samples_up_to_difficulty(save_path: str, metric: str,
@@ -145,3 +357,7 @@ def samples_up_to_difficulty(save_path: str, metric: str,
     hi = int(np.searchsorted(z["values"], max_value, side="right"))
     end = int(z["row_ptr"][hi])
     return z["sample_ids"][:end]
+
+
+if __name__ == "__main__":
+    raise SystemExit(_worker_main())

@@ -238,3 +238,102 @@ def test_analyzer_rejects_mismatched_resume(tmp_path):
     with pytest.raises(ValueError, match="resume mismatch"):
         DataAnalyzer(ds, {"m": lambda s: 1.0}, save_path=str(tmp_path / "i"),
                      num_workers=4).run()
+
+
+# -- distributed analyzer (reference data_analyzer.py:457) -------------------
+
+def _dist_dataset():
+    rng = np.random.default_rng(7)
+    return [rng.integers(0, 100, rng.integers(3, 20)) for _ in range(101)]
+
+
+def _dist_metrics():
+    return {"seqlen": lambda s: float(len(s)),
+            "vocab_sum": lambda s: float(np.sum(s))}
+
+
+def test_distributed_analyzer_matches_single_process(tmp_path):
+    """Rank-sharded map + sentinel-gated reduce must produce byte-identical
+    index files to the single-process DataAnalyzer."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+        DistributedDataAnalyzer, samples_up_to_difficulty)
+
+    ds = _dist_dataset()
+    metrics = _dist_metrics()
+    # single-process truth
+    ref_dir = str(tmp_path / "ref")
+    DataAnalyzer(ds, metrics, save_path=ref_dir, num_workers=2).run()
+    # distributed: 3 ranks map in-process, rank 0 reduces
+    dist_dir = str(tmp_path / "dist")
+    for r in range(3):
+        DistributedDataAnalyzer(ds, metrics, dist_dir, rank=r,
+                                world_size=3).run_map_local()
+    out = DistributedDataAnalyzer(ds, metrics, dist_dir, rank=0,
+                                  world_size=3).run_reduce(timeout_s=5)
+    assert set(out) == {"seqlen", "vocab_sum"}
+    for m in metrics:
+        a = np.load(f"{ref_dir}/{m}_sample_to_metric.npy")
+        b = np.load(f"{dist_dir}/{m}_sample_to_metric.npy")
+        np.testing.assert_array_equal(a, b)
+    # curriculum query works off the distributed index too
+    ids = samples_up_to_difficulty(dist_dir, "seqlen", 8.0)
+    assert all(len(ds[i]) <= 8 for i in ids)
+
+
+def test_distributed_analyzer_reduce_times_out_on_missing_rank(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+        DistributedDataAnalyzer)
+
+    ds = _dist_dataset()
+    an = DistributedDataAnalyzer(ds, _dist_metrics(), str(tmp_path / "d"),
+                                 rank=0, world_size=2)
+    an.run_map_local()  # rank 1 never runs
+    import pytest
+
+    with pytest.raises(TimeoutError, match="ranks \\[1\\]"):
+        an.run_reduce(timeout_s=1.5)
+
+
+def test_distributed_analyzer_spawn_subprocesses(tmp_path):
+    """The reference's multiprocessing map phase: worker subprocesses via
+    the CLI entry, reduce in-process; results match single-process."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+        DistributedDataAnalyzer)
+
+    dist_dir = str(tmp_path / "spawned")
+    out = DistributedDataAnalyzer.spawn_local(
+        "tests.test_data_sampling:_dist_dataset",
+        "tests.test_data_sampling:_dist_metrics",
+        dist_dir, num_procs=2, timeout_s=300)
+    ds = _dist_dataset()
+    ref_dir = str(tmp_path / "ref2")
+    DataAnalyzer(ds, _dist_metrics(), save_path=ref_dir).run()
+    for m in ("seqlen", "vocab_sum"):
+        np.testing.assert_array_equal(
+            np.load(f"{ref_dir}/{m}_sample_to_metric.npy"),
+            np.load(f"{dist_dir}/{m}_sample_to_metric.npy"))
+
+
+def test_distributed_analyzer_rejects_stale_sentinels(tmp_path):
+    """Sentinels describing a different run (other world size/bounds) must
+    fail the reduce loudly, not silently merge stale rank files."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+        DistributedDataAnalyzer)
+
+    ds = _dist_dataset()
+    d = str(tmp_path / "stale")
+    # a prior 2-rank run completed here
+    for r in range(2):
+        DistributedDataAnalyzer(ds, _dist_metrics(), d, rank=r,
+                                world_size=2).run_map_local()
+    # a new 3-rank run reduces without re-mapping everywhere
+    an3 = DistributedDataAnalyzer(ds, _dist_metrics(), d, rank=0,
+                                  world_size=3)
+    import pytest
+
+    with pytest.raises(ValueError, match="DIFFERENT run"):
+        an3.run_reduce(timeout_s=1.0)
+    # and re-mapping THIS rank clears its own stale sentinel first
+    an3.run_map_local()
+    assert not np.load(
+        f"{d}/seqlen_rank0.npy").shape[0] == 0
