@@ -1,0 +1,12 @@
+"""Compression suite (reference: ``deepspeed/compression/``)."""
+
+from .compress import (apply_masks, build_pruning_masks, fake_quantize,
+                       magnitude_prune_mask, quantize_weights_ste,
+                       reduce_layers, sparsity_of)
+from .scheduler import CompressionScheduler, distillation_loss
+
+__all__ = [
+    "apply_masks", "build_pruning_masks", "fake_quantize",
+    "magnitude_prune_mask", "quantize_weights_ste", "reduce_layers",
+    "sparsity_of", "CompressionScheduler", "distillation_loss",
+]

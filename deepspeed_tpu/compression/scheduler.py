@@ -1,0 +1,136 @@
+"""Compression scheduling + knowledge distillation.
+
+Capability analogue of the reference's ``compression/scheduler.py``
+(techniques activate at their ``schedule_offset`` step during training,
+pruning ratios ramp progressively) and the distillation usage its
+compression pipelines assume (student/teacher KD during layer reduction —
+``compression/helper.py`` student-initialization + the XTC/ZeroQuant
+recipes).
+
+Functional design: the scheduler is a pure function of the step — it
+resolves the config into "what is active right now, at what strength", and
+``apply`` produces the compressed view of the params for this step's
+forward.  Nothing is stateful, so it composes with the jitted engine step
+(the step number is already traced state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .compress import (build_pruning_masks, quantize_weights_ste)
+
+
+class CompressionScheduler:
+    """Resolves each technique's activation and strength per step.
+
+    Technique dicts (in ``CompressionConfig``) understand:
+
+    * ``schedule_offset``      — step the technique turns ON (default 0);
+    * ``schedule_offset_end``  — for pruning: the step the RAMP finishes;
+      between offset and offset_end the sparsity rises linearly from 0 to
+      the configured target (the reference's progressive pruning), then
+      holds.  Absent → the full target applies immediately at offset.
+    """
+
+    _PRUNERS = ("sparse_pruning", "row_pruning", "head_pruning")
+
+    def __init__(self, config):
+        self.config = config
+
+    def _tech(self, name: str) -> Dict[str, Any]:
+        return dict(getattr(self.config, name) or {})
+
+    def _active(self, tech: Dict[str, Any], step: int) -> bool:
+        return bool(tech) and step >= int(tech.get("schedule_offset", 0))
+
+    def _ramp_fraction(self, tech: Dict[str, Any], step: int) -> float:
+        """0→1 linearly between schedule_offset and schedule_offset_end
+        (1.0 when no ramp is configured or it has finished)."""
+        start = int(tech.get("schedule_offset", 0))
+        end = int(tech.get("schedule_offset_end", start))
+        if step >= end or end <= start:
+            return 1.0
+        return (step - start) / (end - start)
+
+    def active_config(self, step: int) -> Dict[str, Any]:
+        """{technique: resolved params} for everything active at ``step``."""
+        if not getattr(self.config, "enabled", True):
+            return {}
+        out: Dict[str, Any] = {}
+        wq = self._tech("weight_quantization")
+        if self._active(wq, step):
+            out["weight_quantization"] = {"bits": int(wq.get("bits", 8))}
+        aq = self._tech("activation_quantization")
+        if self._active(aq, step):
+            out["activation_quantization"] = {"bits": int(aq.get("bits", 8))}
+        for name in self._PRUNERS:
+            tech = self._tech(name)
+            if self._active(tech, step):
+                # the TARGET sparsity (either key spells it); the ramp always
+                # runs 0→target — ramping dense_ratio itself would START at
+                # sparsity 1.0 (everything masked) and relax, backwards
+                target = (float(tech["sparsity"]) if "sparsity" in tech
+                          else 1.0 - float(tech.get("dense_ratio", 0.5)))
+                out[name] = dict(
+                    tech, sparsity=self._ramp_fraction(tech, step) * target)
+        lr = self._tech("layer_reduction")
+        if self._active(lr, step):
+            out["layer_reduction"] = lr
+        return out
+
+    def apply(self, params: Any, step: int,
+              num_heads: Optional[int] = None) -> Tuple[Any, Any]:
+        """The compressed view of ``params`` for this step's forward:
+        (possibly-quantized, mask-multiplied params, masks).  Masks are
+        recomputed from the CURRENT weights (magnitude pruning tracks
+        training, like the reference's per-interval mask refresh)."""
+        from .compress import apply_masks
+
+        active = self.active_config(step)
+        out = params
+        if "weight_quantization" in active:
+            out = quantize_weights_ste(
+                out, bits=active["weight_quantization"]["bits"])
+        # translate to the mask builder's dialect ({enabled, dense_ratio})
+        prune_cfg = {
+            k: {"enabled": True, "dense_ratio": 1.0 - active[k]["sparsity"]}
+            for k in self._PRUNERS if k in active
+        }
+        masks = None
+        if prune_cfg:
+            masks = build_pruning_masks(out, prune_cfg, num_heads=num_heads)
+            out = apply_masks(out, masks)
+        return out, masks
+
+
+# ---------------------------------------------------------------------------
+# knowledge distillation (the KD loss the reference's compression recipes
+# pair with layer reduction / quantization-aware training)
+# ---------------------------------------------------------------------------
+
+
+def distillation_loss(student_logits: jax.Array, teacher_logits: jax.Array,
+                      labels: Optional[jax.Array] = None,
+                      temperature: float = 2.0,
+                      alpha: float = 0.5) -> jax.Array:
+    """``alpha · T² · KL(teacher_T ‖ student_T) + (1-alpha) · CE(labels)``
+    — Hinton KD with the standard T² gradient-scale correction.  Teacher
+    logits are stop-gradiented; with ``labels=None`` the CE term drops
+    (pure distillation, alpha ignored)."""
+    t = jnp.asarray(temperature, jnp.float32)
+    s = student_logits.astype(jnp.float32) / t
+    te = jax.lax.stop_gradient(teacher_logits.astype(jnp.float32)) / t
+    log_p_s = jax.nn.log_softmax(s, axis=-1)
+    p_t = jax.nn.softmax(te, axis=-1)
+    kl = jnp.sum(p_t * (jax.nn.log_softmax(te, axis=-1) - log_p_s), axis=-1)
+    kd = (t * t) * kl.mean()
+    if labels is None:
+        return kd
+    ce = -jnp.take_along_axis(
+        jax.nn.log_softmax(student_logits.astype(jnp.float32), axis=-1),
+        labels[..., None], axis=-1)[..., 0].mean()
+    return alpha * kd + (1.0 - alpha) * ce

@@ -196,9 +196,18 @@ class DistributedDataAnalyzer:
 
     def _expected_sentinel(self, rank: int) -> Dict:
         bounds = self._bounds(len(self.dataset))
-        return {"lo": int(bounds[rank]), "hi": int(bounds[rank + 1]),
-                "world_size": self.world_size,
-                "metrics": sorted(self.metric_fns)}
+        out = {"lo": int(bounds[rank]), "hi": int(bounds[rank + 1]),
+               "world_size": self.world_size,
+               "metrics": sorted(self.metric_fns)}
+        # a same-configuration rerun into a reused save_path is
+        # indistinguishable from this run by shape alone — when the launch
+        # provides a run id (spawn_local always does; multi-host runs set
+        # DSTPU_ANALYZER_RUN_ID on every rank), stale sentinels from the
+        # previous run fail the match instead of silently merging old files
+        run_id = os.environ.get("DSTPU_ANALYZER_RUN_ID")
+        if run_id:
+            out["run_id"] = run_id
+        return out
 
     def run_map_local(self) -> None:
         """Analyze THIS rank's contiguous slice and publish it."""
@@ -292,32 +301,44 @@ class DistributedDataAnalyzer:
         import subprocess
         import sys
 
+        import uuid
+
         cmd_tail = ["--dataset", dataset_factory, "--metrics",
                     metric_fns_factory, "--save-path", save_path]
         if metric_types:
             cmd_tail += ["--metric-types", json.dumps(metric_types)]
+        run_id = uuid.uuid4().hex
+        prior = os.environ.get("DSTPU_ANALYZER_RUN_ID")
+        os.environ["DSTPU_ANALYZER_RUN_ID"] = run_id  # reducer expects it
         procs = []
         try:
             for r in range(num_procs):
                 env = dict(os.environ, RANK=str(r),
-                           WORLD_SIZE=str(num_procs), JAX_PLATFORMS="cpu")
+                           WORLD_SIZE=str(num_procs), JAX_PLATFORMS="cpu",
+                           DSTPU_ANALYZER_RUN_ID=run_id)
                 procs.append(subprocess.Popen(
                     [sys.executable, "-m",
                      "deepspeed_tpu.runtime.data_pipeline.data_sampling"
                      ".data_analyzer", *cmd_tail],
                     env=env))
-            rcs = [p.wait(timeout=timeout_s) for p in procs]
+            try:
+                rcs = [p.wait(timeout=timeout_s) for p in procs]
+            finally:
+                for p in procs:  # a hung worker must not outlive the sweep
+                    if p.poll() is None:  # and write into a retried path
+                        p.kill()
+            if any(rcs):
+                raise RuntimeError(f"analyzer workers failed: rcs={rcs}")
+            dataset = _resolve_factory(dataset_factory)()
+            metrics = _resolve_factory(metric_fns_factory)()
+            return DistributedDataAnalyzer(
+                dataset, metrics, save_path, rank=0, world_size=num_procs,
+                metric_types=metric_types).run_reduce(timeout_s)
         finally:
-            for p in procs:  # a hung worker must not outlive the sweep and
-                if p.poll() is None:  # write into a retried save_path
-                    p.kill()
-        if any(rcs):
-            raise RuntimeError(f"analyzer workers failed: rcs={rcs}")
-        dataset = _resolve_factory(dataset_factory)()
-        metrics = _resolve_factory(metric_fns_factory)()
-        return DistributedDataAnalyzer(
-            dataset, metrics, save_path, rank=0, world_size=num_procs,
-            metric_types=metric_types).run_reduce(timeout_s)
+            if prior is None:
+                os.environ.pop("DSTPU_ANALYZER_RUN_ID", None)
+            else:
+                os.environ["DSTPU_ANALYZER_RUN_ID"] = prior
 
 
 def _resolve_factory(spec: str):

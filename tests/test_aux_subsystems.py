@@ -466,3 +466,92 @@ def test_model_based_tuner_survives_failed_candidates(monkeypatch):
     best, exps = tuner.tune()
     assert best == {"zero_stage": 1, "micro_batch": 2}
     assert any(not e.ok for e in exps)
+
+
+# ---------------------------------------------------------------------------
+# compression scheduler + distillation (reference compression/scheduler.py)
+# ---------------------------------------------------------------------------
+
+
+def test_compression_scheduler_activation_and_ramp():
+    from deepspeed_tpu.compression.scheduler import CompressionScheduler
+    from deepspeed_tpu.runtime.config import CompressionConfig
+
+    cfg = CompressionConfig(
+        enabled=True,
+        weight_quantization={"bits": 8, "schedule_offset": 100},
+        sparse_pruning={"sparsity": 0.5, "schedule_offset": 200,
+                        "schedule_offset_end": 400})
+    sch = CompressionScheduler(cfg)
+    assert sch.active_config(0) == {}
+    assert sch.active_config(100) == {"weight_quantization": {"bits": 8}}
+    # sparsity ramps linearly from the offset to offset_end, then holds
+    s250 = sch.active_config(250)["sparse_pruning"]["sparsity"]
+    s300 = sch.active_config(300)["sparse_pruning"]["sparsity"]
+    s400 = sch.active_config(400)["sparse_pruning"]["sparsity"]
+    s999 = sch.active_config(999)["sparse_pruning"]["sparsity"]
+    assert 0 < s250 < s300 < s400 == s999 == 0.5
+    np.testing.assert_allclose(s300, 0.25)
+
+
+def test_compression_scheduler_apply(devices):
+    from deepspeed_tpu.compression.compress import sparsity_of
+    from deepspeed_tpu.compression.scheduler import CompressionScheduler
+    from deepspeed_tpu.runtime.config import CompressionConfig
+
+    cfg_m = tfm.get_config("tiny", dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg_m)
+    sch = CompressionScheduler(CompressionConfig(
+        enabled=True,
+        sparse_pruning={"sparsity": 0.6, "schedule_offset": 10,
+                        "schedule_offset_end": 20}))
+    before, masks0 = sch.apply(params, step=0)
+    assert masks0 is None  # inactive: identity
+    mid, masks_mid = sch.apply(params, step=15)
+    end, masks_end = sch.apply(params, step=30)
+    assert 0.2 < sparsity_of(mid, masks_mid) < sparsity_of(end, masks_end)
+    np.testing.assert_allclose(sparsity_of(end, masks_end), 0.6, atol=0.05)
+
+
+def test_distillation_loss():
+    from deepspeed_tpu.compression.scheduler import distillation_loss
+
+    rng = jax.random.PRNGKey(0)
+    student = jax.random.normal(rng, (4, 16, 32))
+    labels = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 32)
+    # teacher == student → KD term is exactly 0; loss reduces to (1-a)·CE
+    same = distillation_loss(student, student, labels, alpha=0.5)
+    ce = distillation_loss(student, student, labels, alpha=0.0)
+    np.testing.assert_allclose(float(same), 0.5 * float(ce), rtol=1e-5)
+    # pure-KD gradient flows to the student but NOT through the teacher
+    teacher = jax.random.normal(jax.random.PRNGKey(1), (4, 16, 32))
+    g_s, g_t = jax.grad(
+        lambda s, t: distillation_loss(s, t), argnums=(0, 1))(student, teacher)
+    assert float(jnp.abs(g_s).sum()) > 0
+    np.testing.assert_allclose(np.asarray(g_t), 0.0)
+    # a student matching the teacher has lower KD than a random one
+    kd_far = float(distillation_loss(student, teacher))
+    kd_near = float(distillation_loss(teacher + 0.01, teacher))
+    assert kd_near < kd_far
+
+
+def test_compression_scheduler_dense_ratio_ramp_and_enabled_gate():
+    """dense_ratio configs must ramp sparsity 0 -> (1 - dense_ratio), not
+    start fully masked; enabled=False must disable everything."""
+    from deepspeed_tpu.compression.scheduler import CompressionScheduler
+    from deepspeed_tpu.runtime.config import CompressionConfig
+
+    sch = CompressionScheduler(CompressionConfig(
+        enabled=True,
+        row_pruning={"dense_ratio": 0.7, "schedule_offset": 100,
+                     "schedule_offset_end": 200}))
+    s_at_start = sch.active_config(100)["row_pruning"]["sparsity"]
+    s_mid = sch.active_config(150)["row_pruning"]["sparsity"]
+    s_end = sch.active_config(200)["row_pruning"]["sparsity"]
+    assert s_at_start == 0.0  # never "everything masked"
+    np.testing.assert_allclose(s_mid, 0.15)
+    np.testing.assert_allclose(s_end, 0.3)
+
+    off = CompressionScheduler(CompressionConfig(
+        enabled=False, sparse_pruning={"sparsity": 0.5}))
+    assert off.active_config(10_000) == {}

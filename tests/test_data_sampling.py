@@ -333,7 +333,32 @@ def test_distributed_analyzer_rejects_stale_sentinels(tmp_path):
 
     with pytest.raises(ValueError, match="DIFFERENT run"):
         an3.run_reduce(timeout_s=1.0)
-    # and re-mapping THIS rank clears its own stale sentinel first
+    # re-mapping THIS rank replaces its stale sentinel with one describing
+    # the new run — rank 0 is no longer stale (1 and 2 still are/missing)
     an3.run_map_local()
-    assert not np.load(
-        f"{d}/seqlen_rank0.npy").shape[0] == 0
+    import json as _json
+
+    with open(f"{d}/rank0.done") as f:
+        assert _json.load(f) == an3._expected_sentinel(0)
+    assert np.load(f"{d}/seqlen_rank0.npy").shape[0] > 0
+
+
+def test_distributed_analyzer_run_id_blocks_same_config_rerun(tmp_path,
+                                                              monkeypatch):
+    """Same-configuration reruns into a reused save_path are caught when
+    the launch provides a run id (spawn_local always does)."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+        DistributedDataAnalyzer)
+
+    ds = _dist_dataset()
+    d = str(tmp_path / "nonce")
+    monkeypatch.setenv("DSTPU_ANALYZER_RUN_ID", "run-A")
+    DistributedDataAnalyzer(ds, _dist_metrics(), d, rank=0,
+                            world_size=1).run_map_local()
+    monkeypatch.setenv("DSTPU_ANALYZER_RUN_ID", "run-B")
+    an = DistributedDataAnalyzer(ds, _dist_metrics(), d, rank=0,
+                                 world_size=1)
+    import pytest
+
+    with pytest.raises(ValueError, match="DIFFERENT run"):
+        an.run_reduce(timeout_s=1.0)
