@@ -284,6 +284,18 @@ class FlopsProfilerConfig(DSConfigModel):
     output_file: Optional[str] = None
 
 
+class TraceProfilerConfig(DSConfigModel):
+    """On-device trace capture (the reference's wall-clock-breakdown /
+    flops-profiler "profile step N" UX, realized as a jax.profiler trace):
+    steps [start_step, end_step] are captured into ``output_dir`` for
+    TensorBoard / Perfetto."""
+
+    enabled: bool = False
+    start_step: int = 3
+    end_step: int = 5
+    output_dir: str = "dstpu_trace"
+
+
 class CommsLoggerConfig(DSConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -445,6 +457,7 @@ class DeepSpeedTPUConfig(DSConfigModel):
     csv_monitor: MonitorSinkConfig = Field(default_factory=MonitorSinkConfig)
 
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    trace_profiler: TraceProfilerConfig = Field(default_factory=TraceProfilerConfig)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
 
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)

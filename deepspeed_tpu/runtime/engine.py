@@ -908,6 +908,8 @@ class TrainingEngine:
         Returns a Mapping (LazyMetrics): reads materialize floats; convert
         with ``dict(m)`` for serialization.  Not a dict instance."""
         self._assert_streaming_flag()
+        if self.config.trace_profiler.enabled:
+            self._maybe_trace(starting=True)
         self.tput.start()
         lr_scale = None
         if "lr_scale" in batch:  # variable-batch LR (data_sampling)
@@ -942,6 +944,8 @@ class TrainingEngine:
             # counts as step time — otherwise samples/sec reports dispatch rate
             out._materialize()
         self.tput.stop()
+        if self.config.trace_profiler.enabled:
+            self._maybe_trace(starting=False)
         self._write_monitor(out)
         if self.config.sanity_checks:
             self._run_sanity_checks(out)
@@ -950,6 +954,45 @@ class TrainingEngine:
             log_dist(f"step={self.global_steps} loss={out.get('loss', float('nan')):.4f} "
                      f"lr={out['lr']:.2e} grad_norm={out.get('grad_norm', 0.0):.3f}")
         return out
+
+    def _maybe_trace(self, starting: bool) -> None:
+        """jax.profiler trace capture over the configured step window
+        (reference: the flops profiler's "profile at step N" UX — here the
+        artifact is a TensorBoard/Perfetto device trace).  ``starting`` is
+        True before the step runs, False after: the trace starts before
+        ``start_step`` executes and stops after ``end_step`` completes."""
+        cfg = self.config.trace_profiler
+        step_about_to_run = self.global_steps + 1
+        try:
+            # >= (not ==): a checkpoint resume past start_step, or
+            # start_step <= 0, must still capture a window rather than
+            # silently never firing
+            if (starting and not getattr(self, "_tracing", False)
+                    and not getattr(self, "_traced_once", False)
+                    and step_about_to_run >= cfg.start_step
+                    and step_about_to_run <= cfg.end_step):
+                jax.profiler.start_trace(cfg.output_dir)
+                self._tracing = True
+            elif (not starting and self.global_steps >= cfg.end_step
+                    and getattr(self, "_tracing", False)):
+                jax.device_get(self.state.step)  # drain dispatched work
+                jax.profiler.stop_trace()
+                self._tracing = False
+                self._traced_once = True
+                log_dist(f"trace captured: steps up to {cfg.end_step} "
+                         f"-> {cfg.output_dir}")
+        except Exception as e:  # tracing must never kill training
+            if getattr(self, "_tracing", False):
+                # the profiler session MUST end — an orphaned session
+                # buffers trace events in host memory for the rest of the
+                # run and never writes an artifact
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+            self._tracing = False
+            self._traced_once = True
+            logger.warning(f"trace_profiler: capture failed: {e}")
 
     def _run_sanity_checks(self, out) -> None:
         """``sanity_checks`` mode (reference ``engine.py:1346``

@@ -555,3 +555,26 @@ def test_compression_scheduler_dense_ratio_ramp_and_enabled_gate():
     off = CompressionScheduler(CompressionConfig(
         enabled=False, sparse_pruning={"sparsity": 0.5}))
     assert off.active_config(10_000) == {}
+
+
+def test_trace_profiler_captures_window(devices, tmp_path):
+    """trace_profiler: steps [start, end] produce a TensorBoard/Perfetto
+    trace directory; training continues unaffected after capture."""
+    import os
+
+    out_dir = str(tmp_path / "trace")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_lm_spec(), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "trace_profiler": {"enabled": True, "start_step": 2, "end_step": 3,
+                           "output_dir": out_dir},
+        "steps_per_print": 10000,
+    })
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 16)
+    for _ in range(5):
+        m = engine.train_batch(batch)
+    assert np.isfinite(m["loss"])
+    captured = [f for _, _, fs in os.walk(out_dir) for f in fs]
+    assert captured, "no trace files written"
+    assert not getattr(engine, "_tracing", False)
