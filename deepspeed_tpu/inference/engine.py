@@ -144,6 +144,12 @@ class InferenceEngine:
             params = model.params
         if model_config is None or params is None:
             raise ValueError("pass model_config=TransformerConfig and params=")
+        if getattr(model_config, "moe_routing", "capacity") == "expert_choice":
+            raise ValueError(
+                "expert_choice routing is non-causal (experts pick top-C "
+                "tokens over the whole sequence) — autoregressive decode "
+                "with it is incoherent; serve with moe_routing='capacity' "
+                "or 'dropless' (dataclasses.replace(cfg, moe_routing=...))")
         self.model_config = dataclasses.replace(model_config, dtype=icfg.dtype)
         # dp absorbs the remaining devices (params replicated across it)
         self.topo = MeshTopology.from_config(

@@ -333,6 +333,11 @@ class InferenceEngineV2:
 
     def __init__(self, model_config: tfm.TransformerConfig, params: Any,
                  config: Optional[V2Config] = None):
+        if getattr(model_config, "moe_routing", "capacity") == "expert_choice":
+            raise ValueError(
+                "expert_choice routing is non-causal — continuous-batching "
+                "decode with it would route across unrelated requests; "
+                "serve with moe_routing='capacity' or 'dropless'")
         self.cfg = config or V2Config()
         self.model_cfg = dataclasses.replace(model_config, dtype=self.cfg.dtype)
         self.params = params

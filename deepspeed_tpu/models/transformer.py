@@ -60,7 +60,8 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     # 'capacity' (GShard buckets; the ep all-to-all path) | 'dropless'
-    # (grouped-GEMM, no token dropping — moe/dropless.py)
+    # (grouped-GEMM, no token dropping — moe/dropless.py) | 'expert_choice'
+    # (experts pick top-C tokens; balanced by construction)
     moe_routing: str = "capacity"
     # PR-MoE (reference deepspeed/moe/layer.py:17 use_residual): a dense
     # "shared expert" MLP runs beside the MoE and a learned 2-way softmax

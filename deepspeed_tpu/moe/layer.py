@@ -86,6 +86,41 @@ def top_k_gating(logits: jax.Array, num_experts: int, top_k: int,
     return GateOutput(combine, dispatch, aux_loss, z_loss, load)
 
 
+def expert_choice_gating(logits: jax.Array, num_experts: int,
+                         capacity_factor: float, min_capacity: int = 4
+                         ) -> GateOutput:
+    """Expert-choice routing (Zhou et al. 2022; ROADMAP item): EXPERTS pick
+    their top-C tokens instead of tokens picking top-k experts.  Perfectly
+    load-balanced by construction — every expert processes exactly C tokens
+    — so no auxiliary loss is needed (aux_loss = 0); a token may be chosen
+    by several experts or by none (dropped for that layer, residual carries
+    it).  Reuses the (B, S, E, C) dispatch/combine layout so the einsum
+    dispatch path and ep sharding apply unchanged.
+
+    NON-CAUSAL by design (the paper's known caveat): an expert's top-C
+    selection sees the whole sequence, so token t's routing depends on
+    later tokens.  This is a TRAINING-TIME router (encoders, prefix-LM,
+    distillation targets); autoregressive DECODE with it is incoherent —
+    the inference engines refuse it (serve the trained experts with
+    ``moe_routing='capacity'`` or ``'dropless'`` instead)."""
+    B, S, E = logits.shape
+    capacity = max(int(S * capacity_factor / num_experts), min_capacity)
+    capacity = min(capacity, S)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (B,S,E)
+    z = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    z_loss = jnp.mean(z ** 2)
+    # per (batch, expert): top-C tokens by that expert's column
+    col = probs.transpose(0, 2, 1)                    # (B, E, S)
+    vals, idx = jax.lax.top_k(col, capacity)          # (B, E, C)
+    onehot = jax.nn.one_hot(idx, S)                   # (B, E, C, S)
+    # (B, S, E, C): token s fills expert e's slot c iff idx[b,e,c] == s
+    dispatch = onehot.transpose(0, 3, 1, 2) > 0
+    combine = dispatch * vals[:, None, :, :]          # weight = router prob
+    load = dispatch.any(-1).astype(jnp.float32).mean(axis=(0, 1))
+    return GateOutput(combine.astype(jnp.float32), dispatch,
+                      jnp.zeros((), jnp.float32), z_loss, load)
+
+
 def dense_moe_block(x: jax.Array, p: Dict[str, Any], cfg) -> jax.Array:
     """Einsum-dispatch MoE FFN (router losses discarded — use
     ``moe_block_with_losses`` in training forwards that need them).
@@ -112,7 +147,11 @@ def moe_block_with_losses(x: jax.Array, p: Dict[str, Any], cfg
     dt = x.dtype
     E = cfg.num_experts
     logits = x.astype(jnp.float32) @ p["router"].astype(jnp.float32)
-    gate = top_k_gating(logits, E, cfg.moe_top_k, cfg.moe_capacity_factor)
+    if getattr(cfg, "moe_routing", "capacity") == "expert_choice":
+        gate = expert_choice_gating(logits, E, cfg.moe_capacity_factor)
+    else:
+        gate = top_k_gating(logits, E, cfg.moe_top_k,
+                            cfg.moe_capacity_factor)
     disp = gate.dispatch_mask.astype(dt)
     comb = gate.combine_weights.astype(dt)
     xe = jnp.einsum("bsec,bsh->ebch", disp, x)

@@ -29,7 +29,14 @@ from .layer import top_k_gating
 def sharded_moe_block(x: jax.Array, p: Dict[str, Any], cfg) -> jax.Array:
     """Drop-in MoE FFN with explicit ep all-to-all. x: (B, S, H) with batch
     sharded over (dp, fsdp); expert weights sharded over 'ep' on the expert
-    axis.  Requires num_experts % ep == 0."""
+    axis.  Requires num_experts % ep == 0.  Capacity (top-k) routing only —
+    refusing other modes beats silently training with the wrong router."""
+    routing = getattr(cfg, "moe_routing", "capacity")
+    if routing != "capacity":
+        raise ValueError(
+            f"sharded_moe_block implements capacity (top-k) routing only; "
+            f"moe_routing={routing!r} would be silently ignored — use the "
+            f"GSPMD path (dense_moe_block / moe_block_with_losses) for it")
     topo = get_topology()
     ep = topo.size("ep")
     if ep == 1:

@@ -295,6 +295,15 @@ class TraceProfilerConfig(DSConfigModel):
     end_step: int = 5
     output_dir: str = "dstpu_trace"
 
+    @model_validator(mode="after")
+    def _window_sane(self):
+        if self.enabled and (self.end_step < 1
+                             or self.start_step > self.end_step):
+            raise ValueError(
+                f"trace_profiler window [{self.start_step}, {self.end_step}] "
+                f"can never fire — need 1 <= start_step <= end_step")
+        return self
+
 
 class CommsLoggerConfig(DSConfigModel):
     enabled: bool = False

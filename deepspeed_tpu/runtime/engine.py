@@ -973,6 +973,13 @@ class TrainingEngine:
                     and step_about_to_run <= cfg.end_step):
                 jax.profiler.start_trace(cfg.output_dir)
                 self._tracing = True
+                # training may END before end_step (short run, crash) —
+                # without this the session never stops and no artifact is
+                # written; weakref so the hook doesn't pin the engine
+                import atexit
+                import weakref
+
+                atexit.register(_stop_trace_at_exit, weakref.ref(self))
             elif (not starting and self.global_steps >= cfg.end_step
                     and getattr(self, "_tracing", False)):
                 jax.device_get(self.state.step)  # drain dispatched work
@@ -993,6 +1000,19 @@ class TrainingEngine:
             self._tracing = False
             self._traced_once = True
             logger.warning(f"trace_profiler: capture failed: {e}")
+
+    def finalize_trace(self) -> None:
+        """Stop a still-active trace (end of training before ``end_step``)
+        and write the partial artifact.  Idempotent."""
+        if getattr(self, "_tracing", False):
+            self._tracing = False
+            self._traced_once = True
+            try:
+                jax.profiler.stop_trace()
+                log_dist(f"trace stopped at training end (partial window) "
+                         f"-> {self.config.trace_profiler.output_dir}")
+            except Exception as e:
+                logger.warning(f"trace_profiler: stop at exit failed: {e}")
 
     def _run_sanity_checks(self, out) -> None:
         """``sanity_checks`` mode (reference ``engine.py:1346``
@@ -1117,3 +1137,10 @@ class TrainingEngine:
 
         return _load(self, load_dir, tag=tag,
                      load_optimizer_states=load_optimizer_states)
+
+
+def _stop_trace_at_exit(engine_ref) -> None:
+    """atexit hook (module-level so atexit never pins an engine instance)."""
+    engine = engine_ref()
+    if engine is not None:
+        engine.finalize_trace()

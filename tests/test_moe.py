@@ -237,3 +237,42 @@ def test_prmoe_model_trains(devices):
                            np.asarray(jax.device_get(spec_p["res_w_in"])))
     assert not np.allclose(np.asarray(jax.device_get(moe["coef"])),
                            np.asarray(jax.device_get(spec_p["coef"])))
+
+
+def test_expert_choice_gating_balanced_by_construction(devices):
+    """Every expert fills exactly C slots with distinct tokens; aux loss is
+    zero (no balancing term needed)."""
+    from deepspeed_tpu.moe.layer import expert_choice_gating
+
+    B, S, E = 2, 32, 4
+    logits = jax.random.normal(jax.random.PRNGKey(0), (B, S, E))
+    gate = expert_choice_gating(logits, E, capacity_factor=1.0)
+    C = gate.dispatch_mask.shape[-1]
+    assert C == max(int(S * 1.0 / E), 4)
+    # each (batch, expert, slot) holds exactly one token
+    per_slot = np.asarray(gate.dispatch_mask).sum(axis=1)  # (B, E, C)
+    np.testing.assert_array_equal(per_slot, 1)
+    # slots of one expert hold DISTINCT tokens
+    disp = np.asarray(gate.dispatch_mask)
+    for b in range(B):
+        for e in range(E):
+            toks = np.nonzero(disp[b, :, e, :])[0]
+            assert len(set(toks.tolist())) == C
+    assert float(gate.aux_loss) == 0.0
+    # combine weights live where dispatch does
+    comb = np.asarray(gate.combine_weights)
+    assert (comb[~disp] == 0).all() and (comb[disp] > 0).all()
+
+
+def test_expert_choice_model_trains(devices):
+    spec = tiny_lm_spec("tiny-moe", moe_routing="expert_choice")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "steps_per_print": 100,
+        "mesh": {"expert_parallel_size": 4},
+    })
+    rng = np.random.default_rng(0)
+    batch = copy_task_batch(rng, engine.train_batch_size, 32)
+    losses = [engine.train_batch(batch)["loss"] for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.8, losses
