@@ -38,6 +38,12 @@ class AgentConfig:
     coordinator_port: int = DEFAULT_COORDINATOR_PORT
     #: grace period between SIGTERM and SIGKILL when tearing a group down
     term_timeout_s: float = 10.0
+    #: scale-UP debounce (torchelastic's rendezvous last-call window): a
+    #: healthy group is only restarted to absorb new members after the
+    #: grown membership has been stable this long — joining hosts trickle
+    #: in, and restarting per arrival would thrash the job.  Shrinks and
+    #: failures restart immediately (the lost capacity is already gone).
+    scale_up_delay_s: float = 5.0
     #: consecutive crashes before a member is banned from rendezvous for
     #: good; below this a crashed member only sits out a cool-down
     #: (a coordinator death makes every worker exit nonzero at once — those
@@ -81,6 +87,9 @@ class ElasticAgent:
         self._strikes: Dict[str, int] = {}
         #: member → monotonic time at which it may rejoin rendezvous
         self._cooldown: Dict[str, float] = {}
+        # scale-up debounce state (run loop)
+        self._growth_seen: Optional[List[str]] = None
+        self._growth_since: float = 0.0
 
     # -- world sizing ---------------------------------------------------
 
@@ -171,6 +180,32 @@ class ElasticAgent:
 
             new_members = self.admitted_members(self.members_fn())
             membership_changed = new_members != self.current_members
+
+            # pure growth of a HEALTHY group: debounce — restart only after
+            # the grown membership holds stable for scale_up_delay_s
+            # (joining hosts trickle in; restarting per arrival thrashes).
+            # Exception: growth consisting ONLY of crash-rejoiners is
+            # already time-gated by their cool-down — restart immediately
+            # (the striking semantics depend on prompt re-admission).
+            newly = set(new_members) - set(self.current_members)
+            crash_rejoiners = newly & (set(self._strikes)
+                                       | set(self._cooldown))
+            if (membership_changed and not any_failed
+                    and set(new_members) > set(self.current_members)
+                    and newly - crash_rejoiners):
+                now = time.monotonic()
+                grown = sorted(new_members)  # order flaps must not reset
+                if self._growth_seen != grown:
+                    self._growth_seen = grown
+                    self._growth_since = now
+                    logger.info(
+                        f"elastic agent: growth detected → {grown}; "
+                        f"absorbing in {self.cfg.scale_up_delay_s:.0f}s if "
+                        f"stable")
+                if now - self._growth_since < self.cfg.scale_up_delay_s:
+                    continue  # keep the healthy group running meanwhile
+            else:
+                self._growth_seen = None
 
             if any_failed or membership_changed:
                 reason = ("worker failure" if any_failed

@@ -144,7 +144,8 @@ class InferenceEngine:
             params = model.params
         if model_config is None or params is None:
             raise ValueError("pass model_config=TransformerConfig and params=")
-        if getattr(model_config, "moe_routing", "capacity") == "expert_choice":
+        if (getattr(model_config, "num_experts", 0) > 0 and
+                getattr(model_config, "moe_routing", "capacity") == "expert_choice"):
             raise ValueError(
                 "expert_choice routing is non-causal (experts pick top-C "
                 "tokens over the whole sequence) — autoregressive decode "

@@ -333,7 +333,8 @@ class InferenceEngineV2:
 
     def __init__(self, model_config: tfm.TransformerConfig, params: Any,
                  config: Optional[V2Config] = None):
-        if getattr(model_config, "moe_routing", "capacity") == "expert_choice":
+        if (getattr(model_config, "num_experts", 0) > 0 and
+                getattr(model_config, "moe_routing", "capacity") == "expert_choice"):
             raise ValueError(
                 "expert_choice routing is non-causal — continuous-batching "
                 "decode with it would route across unrelated requests; "

@@ -89,6 +89,29 @@ class WandbMonitor(Monitor):  # pragma: no cover - needs network
             self.wandb.log({name: value}, step=step)
 
 
+class CometMonitor(Monitor):  # pragma: no cover - needs network
+    """Reference ``monitor/comet.py``: events forwarded to a comet_ml
+    Experiment.  Import-guarded like wandb — absent SDK degrades to off."""
+
+    def __init__(self, project=None, job_name="job", **kwargs):
+        try:
+            import comet_ml
+
+            self.experiment = comet_ml.Experiment(project_name=project,
+                                                  **kwargs)
+            self.experiment.set_name(job_name)
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"comet_ml unavailable: {e}")
+            self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self.experiment.log_metric(name, value, step=step)
+
+
 class MonitorMaster(Monitor):
     """Fan-out to all enabled sinks; only process 0 writes (reference
     MonitorMaster rank gating)."""
@@ -107,6 +130,9 @@ class MonitorMaster(Monitor):
         if wb.enabled:
             self.monitors.append(WandbMonitor(wb.team, wb.group, wb.project,
                                               wb.job_name))
+        cm = getattr(config, "comet", None)
+        if cm is not None and cm.enabled:
+            self.monitors.append(CometMonitor(cm.project, cm.job_name))
         if cv.enabled:
             self.monitors.append(CSVMonitor(cv.output_path or "./csv_logs",
                                             cv.job_name))

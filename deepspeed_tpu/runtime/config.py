@@ -463,6 +463,7 @@ class DeepSpeedTPUConfig(DSConfigModel):
 
     tensorboard: MonitorSinkConfig = Field(default_factory=MonitorSinkConfig)
     wandb: MonitorSinkConfig = Field(default_factory=MonitorSinkConfig)
+    comet: MonitorSinkConfig = Field(default_factory=MonitorSinkConfig)
     csv_monitor: MonitorSinkConfig = Field(default_factory=MonitorSinkConfig)
 
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)

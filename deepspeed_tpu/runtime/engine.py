@@ -1008,6 +1008,13 @@ class TrainingEngine:
             self._tracing = False
             self._traced_once = True
             try:
+                # drain dispatched work like the in-window stop path — the
+                # partial artifact should hold the in-flight steps' device
+                # activity, not just host-side dispatch
+                jax.device_get(self.state.step)
+            except Exception:
+                pass
+            try:
                 jax.profiler.stop_trace()
                 log_dist(f"trace stopped at training end (partial window) "
                          f"-> {self.config.trace_profiler.output_dir}")

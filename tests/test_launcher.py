@@ -319,3 +319,46 @@ def test_natural_sorted_slurm_order():
 
     assert natural_sorted(["node10", "node2", "node1"]) == \
         ["node1", "node2", "node10"]
+
+
+def test_elastic_agent_scale_up_with_debounce(tmp_path):
+    """New members joining a HEALTHY group trigger ONE restart at the grown
+    size — after the stability window, not per arrival."""
+    import sys
+    import time as _time
+    from deepspeed_tpu.elasticity.elastic_agent import AgentConfig, ElasticAgent
+
+    marker = tmp_path / "runs"
+    marker.mkdir()
+    script = tmp_path / "worker.py"
+    script.write_text(f"""
+import os, time
+m = os.environ["DSTPU_ELASTIC_MEMBER"]
+open(r"{marker}" + "/" + m + "-n" + os.environ["NUM_PROCESSES"]
+     + "-r" + os.environ["DSTPU_RESTART_COUNT"], "w").close()
+time.sleep({{}}.get(os.environ["DSTPU_RESTART_COUNT"], 6.0))
+""".format("{'1': 0.6}"))
+    members = {"value": ["h1", "h2"]}
+    t0 = _time.monotonic()
+
+    def members_fn():
+        # two more hosts trickle in once the first group is running
+        if (marker / "h1-n2-r0").exists():
+            if len(members["value"]) == 2:
+                members["value"] = ["h1", "h2", "h3"]
+            elif (len(members["value"]) == 3
+                    and _time.monotonic() - t0 > 1.0):
+                members["value"] = ["h1", "h2", "h3", "h4"]
+        return members["value"]
+
+    agent = ElasticAgent(
+        [sys.executable, str(script)], members_fn=members_fn,
+        agent_config=AgentConfig(max_restarts=3, poll_interval_s=0.2,
+                                 term_timeout_s=2.0, scale_up_delay_s=1.5))
+    rc = agent.run()
+    assert rc == 0
+    runs = {p.name for p in marker.iterdir()}
+    assert "h1-n2-r0" in runs            # started at 2
+    assert "h4-n4-r1" in runs, runs      # ONE restart absorbed both joiners
+    assert agent.restart_count == 1      # debounce: no restart at size 3
+    assert not any(r.endswith("-n3-r1") for r in runs), runs
