@@ -80,3 +80,87 @@ class RepeatingLoader:
         except StopIteration:
             self._it = iter(self.loader)
             return next(self._it)
+
+
+class PlacedBatch:
+    """A batch already sharded onto the mesh (``engine.place_batch``).
+    ``engine.train_batch`` skips placement for these — the H2D transfer was
+    dispatched earlier, overlapping the previous step's compute."""
+
+    __slots__ = ("placed", "lr_scale")
+
+    def __init__(self, placed: Any, lr_scale: Optional[float] = None):
+        self.placed = placed
+        self.lr_scale = lr_scale
+
+
+class PrefetchLoader:
+    """Pipeline the input path: a worker thread prepares (and, given
+    ``place_fn``, device-places) up to ``depth`` batches ahead while the
+    device runs the current step.
+
+    Role of the reference loader's ``pin_memory`` + worker processes
+    (``runtime/dataloader.py``), TPU-shaped: jax dispatch is async, so
+    calling ``engine.place_batch`` from the worker thread starts the
+    host→device copy early — by the time ``train_batch`` needs the data it
+    is already on device (the ROADMAP "input-pipeline prefetch" lever).
+
+    Exceptions from the source loader or ``place_fn`` re-raise at the
+    consuming ``__next__`` call."""
+
+    _SENTINEL = object()
+
+    def __init__(self, loader: Iterable, place_fn: Optional[Callable] = None,
+                 depth: int = 2):
+        self.loader = loader
+        self.place_fn = place_fn
+        self.depth = max(1, depth)
+
+    def __len__(self) -> int:
+        return len(self.loader)  # type: ignore[arg-type]
+
+    def __iter__(self) -> Iterator[Any]:
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def offer(item) -> bool:
+            """Bounded put that gives up when the consumer is gone — a plain
+            q.put would block forever after an early `break` (the NORMAL
+            pattern with RepeatingLoader), leaking the thread and pinning
+            device-placed batches for the process lifetime."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def work():
+            try:
+                for batch in self.loader:
+                    if stop.is_set():
+                        return
+                    if not offer(self.place_fn(batch) if self.place_fn
+                                 else batch):
+                        return
+            except BaseException as e:  # re-raised consumer-side
+                offer(e)
+                return
+            offer(self._SENTINEL)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()  # early exit (break / GeneratorExit): release worker

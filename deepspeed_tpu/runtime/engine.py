@@ -900,6 +900,20 @@ class TrainingEngine:
     # public API (reference surface)
     # ------------------------------------------------------------------
 
+    def place_batch(self, batch: Dict[str, np.ndarray]) -> "Any":
+        """Shard a host batch onto the mesh NOW (async dispatch) and return
+        a ``PlacedBatch`` that ``train_batch`` consumes without re-placing.
+        Thread-safe: ``PrefetchLoader(loader, place_fn=engine.place_batch)``
+        overlaps the H2D copy of batch N+1 with step N's compute."""
+        from .data_pipeline.loader import PlacedBatch
+
+        lr_scale = None
+        if "lr_scale" in batch:
+            batch = dict(batch)
+            lr_scale = np.float32(batch.pop("lr_scale"))
+        placed = self._place_batch(batch, allow_variable=lr_scale is not None)
+        return PlacedBatch(placed, lr_scale)
+
     def train_batch(self, batch: Dict[str, np.ndarray]
                     ) -> "collections.abc.Mapping[str, float]":
         """One full global-batch step (fwd+bwd+opt).  Reference:
@@ -907,15 +921,23 @@ class TrainingEngine:
 
         Returns a Mapping (LazyMetrics): reads materialize floats; convert
         with ``dict(m)`` for serialization.  Not a dict instance."""
+        from .data_pipeline.loader import PlacedBatch
+
         self._assert_streaming_flag()
         if self.config.trace_profiler.enabled:
             self._maybe_trace(starting=True)
         self.tput.start()
-        lr_scale = None
-        if "lr_scale" in batch:  # variable-batch LR (data_sampling)
-            batch = dict(batch)
-            lr_scale = np.float32(batch.pop("lr_scale"))
-        placed = self._place_batch(batch, allow_variable=lr_scale is not None)
+        if isinstance(batch, PlacedBatch):
+            # pre-placed by PrefetchLoader/place_batch: the H2D transfer was
+            # dispatched while the previous step ran
+            placed, lr_scale = batch.placed, batch.lr_scale
+        else:
+            lr_scale = None
+            if "lr_scale" in batch:  # variable-batch LR (data_sampling)
+                batch = dict(batch)
+                lr_scale = np.float32(batch.pop("lr_scale"))
+            placed = self._place_batch(batch,
+                                       allow_variable=lr_scale is not None)
         if self.offload_enabled:
             out = self._train_batch_offloaded(placed, lr_scale)
         elif (getattr(self, "_train_step_onebit", None) is not None
@@ -1089,9 +1111,14 @@ class TrainingEngine:
         set_param_streaming(self.param_offload_enabled)
 
     def eval_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        from .data_pipeline.loader import PlacedBatch
+
         self._assert_streaming_flag()
         self.flush_delayed_update()
-        placed = self._place_batch(batch)
+        if isinstance(batch, PlacedBatch):  # prefetched validation loops
+            placed = batch.placed
+        else:
+            placed = self._place_batch(batch)
         flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), placed)
         metrics = self._eval_step(self.state, flat)
         return {k: float(v) for k, v in metrics.items()}
