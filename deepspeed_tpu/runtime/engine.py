@@ -927,17 +927,11 @@ class TrainingEngine:
         if self.config.trace_profiler.enabled:
             self._maybe_trace(starting=True)
         self.tput.start()
-        if isinstance(batch, PlacedBatch):
-            # pre-placed by PrefetchLoader/place_batch: the H2D transfer was
-            # dispatched while the previous step ran
-            placed, lr_scale = batch.placed, batch.lr_scale
-        else:
-            lr_scale = None
-            if "lr_scale" in batch:  # variable-batch LR (data_sampling)
-                batch = dict(batch)
-                lr_scale = np.float32(batch.pop("lr_scale"))
-            placed = self._place_batch(batch,
-                                       allow_variable=lr_scale is not None)
+        if not isinstance(batch, PlacedBatch):
+            batch = self.place_batch(batch)  # ONE home for the lr_scale pop
+        # pre-placed (PrefetchLoader): the H2D transfer was dispatched while
+        # the previous step ran
+        placed, lr_scale = batch.placed, batch.lr_scale
         if self.offload_enabled:
             out = self._train_batch_offloaded(placed, lr_scale)
         elif (getattr(self, "_train_step_onebit", None) is not None
