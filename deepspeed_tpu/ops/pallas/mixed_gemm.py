@@ -194,6 +194,112 @@ def dequantize_gemm_weight(qw: QuantizedWeight) -> jax.Array:
     return (w * qw.scales[..., :, None, :]).reshape(*lead, K, N)
 
 
+def _int8_gemm_kernel(xc_ref, xs_ref, c_ref, s_ref, o_ref, acc_ref):
+    """W8A8: int8×int8 → int32 on the MXU per k-tile, rescaled into an f32
+    accumulator by (activation row scale) ⊗ (weight column scale)."""
+    kk = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    i32 = jax.lax.dot_general(
+        xc_ref[:], c_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)  # (tm, tn)
+    # xs_ref block is (1, tm, 1): k-group leads as a batch dim so the tile's
+    # last two dims stay Mosaic-legal (see the x-scale spec below)
+    acc_ref[:] += i32.astype(jnp.float32) * xs_ref[0] * s_ref[0]
+
+    @pl.when(kk == nk - 1)
+    def _flush():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _flatten_pad_tiles(x: jax.Array, N: int):
+    """Shared GEMM prologue: collapse lead dims, pad M to the sublane
+    multiple, pick (tm, tn) tiles.  Returns (x2, lead, M, pad_m, tm, tn);
+    tm/tn are None when no aligned tiling exists (→ oracle fallback)."""
+    *lead, K = x.shape
+    M = 1
+    for d in lead:
+        M *= d
+    x2 = x.reshape(M, K)
+    pad_m = (-M) % 8
+    tm = aligned_divisor(M + pad_m, 256)
+    tn = aligned_divisor(N, 256, 128)
+    return x2, lead, M, pad_m, tm, tn
+
+
+def quantize_activations_rowwise(x2: jax.Array, group: int
+                                 ) -> Tuple[jax.Array, jax.Array]:
+    """Per-(row, K-group) symmetric int8 quantization of (M, K) activations
+    — the dynamic-activation half of W8A8 (reference ZeroQuant-style
+    token-wise activation quantization)."""
+    M, K = x2.shape
+    xg = x2.astype(jnp.float32).reshape(M, K // group, group)
+    scale = jnp.max(jnp.abs(xg), axis=-1, keepdims=True) / 127.0
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    codes = jnp.clip(jnp.round(xg / scale), -128, 127).astype(jnp.int8)
+    return codes.reshape(M, K), scale[..., 0]  # (M, K), (M, K/group)
+
+
+def int8_gemm(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
+    """W8A8 ``quant(x) @ dequant-free(qw)``: activations quantize per
+    (token, K-group) at runtime, the matmul runs int8×int8→int32 on the MXU
+    and rescales per tile — HALF the MXU-input bandwidth of W8A16 and the
+    int8 matmul throughput of v5e (the ROADMAP "int8 matmul paths" lever).
+
+    ``qw`` must be bits=8 per-layer (K, N) codes with x's K matching.
+    Falls back to the dequantize oracle off the tiling envelope."""
+    if qw.bits != 8:
+        raise ValueError(f"int8_gemm needs bits=8 weights, got {qw.bits}")
+    if qw.codes.ndim != 2:
+        raise ValueError("int8_gemm wants per-layer (K, N) codes; got "
+                         f"{qw.codes.shape} — slice stacked layers via scan")
+    K = x.shape[-1]
+    if K != qw.k_features:
+        raise ValueError(
+            f"x K={K} != weight K={qw.k_features} — a partial product "
+            f"would be silently wrong")
+    N = qw.out_features
+    x2, lead, M, pad_m, tm, tn = _flatten_pad_tiles(x, N)
+    # int8 MXU tiles want lane-aligned k-tiles; no group==K escape here —
+    # a misaligned single tile would pass interpret mode and fail Mosaic
+    usable = (tm is not None and tn is not None and K % qw.group == 0
+              and qw.group % 128 == 0)
+    if not usable:
+        out = (x2 @ dequantize_gemm_weight(qw).astype(x2.dtype))
+        return out.reshape(*lead, N)
+    xp = jnp.pad(x2, ((0, pad_m), (0, 0))) if pad_m else x2
+    codes, scales = quantize_activations_rowwise(xp, qw.group)
+    tk = qw.group
+    grid = ((M + pad_m) // tm, N // tn, K // tk)
+    out = pl.pallas_call(
+        _int8_gemm_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tm, tk), lambda i, j, kk: (i, kk)),
+            # x scales ride as (K/group, M, 1): the k-group axis LEADS as a
+            # batch dim so the block's last two dims are (tm, 1=full) —
+            # a (tm, 1) block over (M, K/group) would put an unaligned,
+            # non-full tile in the lane dim and fail Mosaic on real TPUs
+            pl.BlockSpec((1, tm, 1), lambda i, j, kk: (kk, i, 0)),
+            pl.BlockSpec((tk, tn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, 1, tn), lambda i, j, kk: (kk, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M + pad_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(codes, scales.T[:, :, None], qw.codes, qw.scales[:, None, :])
+    if pad_m:
+        out = out[:M]
+    return out.reshape(*lead, N)
+
+
 def mixed_gemm(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     """``x @ dequant(qw)`` with in-kernel dequantization.
 
@@ -203,18 +309,12 @@ def mixed_gemm(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     if qw.codes.ndim != 2:
         raise ValueError("mixed_gemm wants per-layer (K, N) codes; got "
                          f"{qw.codes.shape} — slice stacked layers via scan")
-    *lead, K = x.shape
+    K = x.shape[-1]
     N = qw.out_features
-    M = 1
-    for d in lead:
-        M *= d
-    x2 = x.reshape(M, K)
     # ragged M (e.g. prefill with an odd token count) pads up to the sublane
     # multiple so the kernel path — the whole bandwidth win — is never lost
     # to an unlucky batch·seq product
-    pad_m = (-M) % 8
-    tm = aligned_divisor(M + pad_m, 256)
-    tn = aligned_divisor(N, 256, 128)
+    x2, lead, M, pad_m, tm, tn = _flatten_pad_tiles(x, N)
     # int4 packs two codes per byte (group must be even); fp6 packs 4 K-rows
     # per 3 byte-rows (group must divide by 4, and the byte-row tile must be
     # sublane-aligned); int8 has no pack constraint
