@@ -45,7 +45,10 @@ class CompressionScheduler:
         return dict(getattr(self.config, name) or {})
 
     def _active(self, tech: Dict[str, Any], step: int) -> bool:
-        return bool(tech) and step >= int(tech.get("schedule_offset", 0))
+        # a per-technique {"enabled": false, ...} must win — it is the
+        # dialect build_pruning_masks documents and apply() itself emits
+        return (bool(tech) and bool(tech.get("enabled", True))
+                and step >= int(tech.get("schedule_offset", 0)))
 
     def _ramp_fraction(self, tech: Dict[str, Any], step: int) -> float:
         """0→1 linearly between schedule_offset and schedule_offset_end

@@ -166,14 +166,16 @@ class FastFileWriter:
     # -- submission/drain helpers ---------------------------------------
     def _submit_file(self, fd: int, arrays: Dict[str, np.ndarray],
                      header: bytes, offsets: Dict[str, int],
-                     data_bytes: int) -> List[int]:
-        """Submit one file's header + zero-copy tensor segments; returns
-        the request ids.  Segment size spreads the payload over the pool
-        but never drops below 8 MiB (tiny segments = syscall overhead,
-        not parallelism)."""
+                     data_bytes: int, out_reqs: List[int]) -> None:
+        """Submit one file's header + zero-copy tensor segments, APPENDING
+        request ids to ``out_reqs`` as they are issued — a returned list
+        would be lost if submission raises partway, leaving the caller
+        unable to drain the in-flight requests before closing the fd.
+        Segment size spreads the payload over the pool but never drops
+        below 8 MiB (tiny segments = syscall overhead, not parallelism)."""
         h = self._aio
-        reqs = [h.fd_pwrite(fd, np.frombuffer(header, np.uint8),
-                            len(header), 0)]
+        out_reqs.append(h.fd_pwrite(fd, np.frombuffer(header, np.uint8),
+                                    len(header), 0))
         base = len(header)
         seg = max(8 << 20, data_bytes // max(self.thread_count, 1))
         for name, arr in arrays.items():
@@ -184,8 +186,8 @@ class FastFileWriter:
             for s in range(0, arr.nbytes, seg):
                 n = min(seg, arr.nbytes - s)
                 ptr = ctypes.c_void_p(addr + s)
-                reqs.append(h.fd_pwrite(fd, ptr, n, file_off + s, pin=arr))
-        return reqs
+                out_reqs.append(h.fd_pwrite(fd, ptr, n, file_off + s,
+                                            pin=arr))
 
     def _drain_and_close(self, fds: List[int], reqs: List[int],
                          truncate_to: int = -1) -> None:
@@ -221,7 +223,16 @@ class FastFileWriter:
             mode = "o_direct"
         else:
             fd = self._aio.open_write(path, use_direct=False)
-            reqs = self._submit_file(fd, arrays, header, offsets, data_bytes)
+            reqs: List[int] = []
+            try:
+                self._submit_file(fd, arrays, header, offsets, data_bytes,
+                                  reqs)
+            except BaseException:
+                # partial submission (interrupt/OOM): drain what made it
+                # into the pool before the fd closes — same guard as
+                # save_trees/_write_direct
+                self._drain_and_close([fd], reqs)
+                raise
             self._drain_and_close([fd], reqs)
             mode = "buffered"
         dt = time.perf_counter() - t0
@@ -324,8 +335,8 @@ class FastFileWriter:
                 total += len(header) + data_bytes
                 fd = self._aio.open_write(path, use_direct=False)
                 fds.append(fd)
-                reqs.extend(self._submit_file(fd, arrays, header, offsets,
-                                              data_bytes))
+                self._submit_file(fd, arrays, header, offsets, data_bytes,
+                                  reqs)
         except BaseException:
             self._drain_and_close(fds, reqs)
             raise

@@ -97,5 +97,13 @@ def sharded_moe_block(x: jax.Array, p: Dict[str, Any], cfg) -> jax.Array:
         fn = lambda x, r, wi, wo: local(x, r, wi, None, wo)
         args = (x, p["router"], p["w_in"], p["w_out"])
         specs = (x_spec, P(None, None), P("ep"), P("ep"))
-    return shard_map(fn, mesh=topo.mesh, in_specs=specs,
-                     out_specs=x_spec, check_vma=False)(*args)
+    y = shard_map(fn, mesh=topo.mesh, in_specs=specs,
+                  out_specs=x_spec, check_vma=False)(*args)
+    if getattr(cfg, "moe_use_residual", False):
+        # PR-MoE shared expert + mixing coefficient is a dense per-token
+        # computation — applied OUTSIDE the ep shard_map, same math as the
+        # GSPMD path (training here then serving there must agree)
+        from .layer import _prmoe_combine
+
+        y = _prmoe_combine(x, y, p, cfg)
+    return y

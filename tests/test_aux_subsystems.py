@@ -578,3 +578,14 @@ def test_trace_profiler_captures_window(devices, tmp_path):
     captured = [f for _, _, fs in os.walk(out_dir) for f in fs]
     assert captured, "no trace files written"
     assert not getattr(engine, "_tracing", False)
+
+
+def test_compression_per_technique_enabled_false_wins():
+    from deepspeed_tpu.compression.scheduler import CompressionScheduler
+    from deepspeed_tpu.runtime.config import CompressionConfig
+
+    sch = CompressionScheduler(CompressionConfig(
+        enabled=True,
+        sparse_pruning={"enabled": False, "dense_ratio": 0.5},
+        weight_quantization={"enabled": False, "bits": 4}))
+    assert sch.active_config(10_000) == {}
