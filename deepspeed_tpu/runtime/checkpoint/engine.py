@@ -59,6 +59,21 @@ def _load_tree_flat(path: str) -> Dict[str, np.ndarray]:
     return arrays
 
 
+def _full_host_tree(tree: Any) -> Any:
+    """Full (unsharded) host copy of a pytree whose leaves may be sharded
+    across processes.  Single-process: plain ``device_get``.  Multi-process:
+    ``process_allgather`` — a COLLECTIVE, so every process must call this
+    even though only process 0 writes the result (reference parity: ZeRO
+    checkpoint consolidation gathers partitions before rank 0 saves)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(tree, tiled=True)
+
+
 def _unflatten_like(template: Any, flat: Dict[str, np.ndarray]) -> Any:
     paths = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
@@ -83,18 +98,16 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
     state = engine.state
 
-    if jax.process_index() != 0:
-        return ckpt_dir
-
     # Snapshot to host SYNCHRONOUSLY: the next train step donates the current
-    # state's device buffers, so the device_get must happen before this
-    # function returns, never inside the background thread.
-    host_params = jax.device_get(state.params)
+    # state's device buffers, so the host copy must happen before this
+    # function returns, never inside the background thread.  In multi-process
+    # the snapshot is a collective (every process gathers; process 0 writes).
+    host_params = _full_host_tree(state.params)
     if getattr(engine, "offloaded_optimizer", None) is not None:
-        host_opt = jax.device_get(
+        host_opt = _full_host_tree(
             engine.offloaded_optimizer.state_for_checkpoint())
     else:
-        host_opt = jax.device_get(state.opt_state)
+        host_opt = _full_host_tree(state.opt_state)
     meta = {
         "step": int(state.step),
         "skipped_steps": int(state.skipped_steps),
@@ -113,8 +126,8 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     # they ride in their own file (absent → restored as zeros with a warning)
     host_onebit = None
     if getattr(engine, "_onebit_wres", None) is not None:
-        host_onebit = jax.device_get({"worker": engine._onebit_wres,
-                                      "server": engine._onebit_sres})
+        host_onebit = _full_host_tree({"worker": engine._onebit_wres,
+                                       "server": engine._onebit_sres})
 
     def _write_trees():
         model_path = os.path.join(ckpt_dir, "model.safetensors")
@@ -146,14 +159,24 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             log_dist(f"saved checkpoint {ckpt_dir}")
             _prune_old(save_dir, cfg.keep_n_latest)
 
-    if cfg.async_save:
-        # decoupled checkpoint engine (reference: decoupled_checkpoint_engine.py):
-        # the host snapshot is complete, only file IO runs off-thread.
-        t = threading.Thread(target=_do_save, daemon=False)
-        t.start()
-        _async_threads.append(t)
-    else:
-        _do_save()
+    # only process 0 writes; EVERY process reaches the barrier below (a
+    # rank-gated barrier would deadlock process 0)
+    if jax.process_index() == 0:
+        if cfg.async_save:
+            # decoupled checkpoint engine (reference:
+            # decoupled_checkpoint_engine.py): the host snapshot is complete,
+            # only file IO runs off-thread.
+            t = threading.Thread(target=_do_save, daemon=False)
+            t.start()
+            _async_threads.append(t)
+        else:
+            _do_save()
+    if not cfg.async_save and jax.process_count() > 1:
+        # non-zero processes must not observe a half-written checkpoint
+        # (e.g. an immediate load_checkpoint on shared storage)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("dstpu_ckpt_saved")
     return ckpt_dir
 
 
@@ -202,6 +225,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     ckpt_dir = os.path.join(load_dir, tag)
     if not os.path.isdir(ckpt_dir):
         raise FileNotFoundError(f"checkpoint dir not found: {ckpt_dir}")
+
+    if engine.config.checkpoint.engine == "orbax":
+        return _load_orbax(engine, ckpt_dir,
+                           load_optimizer_states=load_optimizer_states)
 
     with open(os.path.join(ckpt_dir, "engine_state.json")) as f:
         meta = json.load(f)
@@ -319,7 +346,7 @@ def _validate_tag(engine, meta: Dict) -> None:
         logger.warning(msg)
 
 
-def _save_orbax(engine, save_dir: str, tag: str) -> str:  # pragma: no cover
+def _save_orbax(engine, save_dir: str, tag: str) -> str:
     import orbax.checkpoint as ocp
 
     path = os.path.join(os.path.abspath(save_dir), tag)
@@ -327,9 +354,65 @@ def _save_orbax(engine, save_dir: str, tag: str) -> str:  # pragma: no cover
     ckptr.save(path + "/state", engine.state)
     ckptr.wait_until_finished()
     if jax.process_index() == 0:
+        with open(os.path.join(path, "engine_state.json"), "w") as f:
+            json.dump({"step": int(engine.state.step),
+                       "zero_stage": engine.zero_stage,
+                       "world_size": engine.topo.world_size,
+                       "framework_version": _version()}, f)
         with open(os.path.join(save_dir, _LATEST), "w") as f:
             f.write(tag)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("dstpu_orbax_saved")
     return path
+
+
+def _load_orbax(engine, ckpt_dir: str, load_optimizer_states: bool = True
+                ) -> Tuple[str, Dict]:
+    """Restore an orbax checkpoint into the engine, resharding to the
+    engine's CURRENT topology: the restore target is built from the live
+    state's shardings, so a checkpoint written on one mesh loads onto
+    another (orbax reads each process's shards of the target sharding).
+    ``load_optimizer_states=False`` keeps the engine's fresh optimizer state
+    (same contract as the native path)."""
+    import dataclasses
+
+    import orbax.checkpoint as ocp
+
+    meta_path = os.path.join(ckpt_dir, "engine_state.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            _validate_tag(engine, json.load(f))
+
+    ckptr = ocp.StandardCheckpointer()
+    target = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if isinstance(x, jax.Array) else x,
+        engine.state)
+    restored = ckptr.restore(
+        os.path.join(os.path.abspath(ckpt_dir), "state"), target)
+
+    def _uncommit(x):
+        # scalar leaves (step, loss-scale counters) live uncommitted on the
+        # default device in a fresh engine; orbax restores them COMMITTED to
+        # one local device, and jit rejects that placement against the
+        # mesh-sharded params — hand them back as host values
+        if isinstance(x, jax.Array) and len(x.sharding.device_set) == 1:
+            return jnp.asarray(jax.device_get(x))
+        return x
+
+    restored = jax.tree.map(_uncommit, restored)
+    if not load_optimizer_states:
+        restored = dataclasses.replace(restored,
+                                       opt_state=engine.state.opt_state)
+    if getattr(engine, "_pending_grads", None) is not None:
+        engine._pending_grads = None
+        engine._pending_lr_scale = None
+    engine.state = restored
+    engine.global_steps = int(restored.step)
+    log_dist(f"loaded orbax checkpoint {ckpt_dir} (step {engine.global_steps})")
+    return ckpt_dir, {}
 
 
 def _version() -> str:

@@ -1,0 +1,71 @@
+"""Multi-process distributed tier (the repo's ``DistributedExec``).
+
+Every test here spawns REAL processes that rendezvous via
+``jax.distributed.initialize`` (gloo CPU collectives) — the process tier of
+``comm/comm.py``, the launcher env contract, cross-process device arrays,
+and multi-host checkpointing run for real, not on the in-process virtual
+mesh.  Reference pattern: ``tests/unit/common.py:139 DistributedExec``.
+"""
+
+import numpy as np
+import pytest
+
+from tests.dist.runner import run_distributed
+
+pytestmark = pytest.mark.slow  # each test spawns N python+jax processes
+
+
+def test_comm_facade_two_processes():
+    n = 4  # 2 procs x 2 local devices
+    results = run_distributed("comm_facade", nprocs=2, local_devices=2)
+    x = np.arange(n * 2, dtype=np.float32).reshape(n, 2) + 1.0
+    sq = np.arange(n * n, dtype=np.float32).reshape(n, n)
+    for res in results:
+        r = res["result"]
+        assert r["world"] == 2 and r["ndev"] == n
+        assert r["bcast"] == [7]  # rank 0's value everywhere
+        np.testing.assert_allclose(r["all_reduce"],
+                                   x.sum(axis=0, keepdims=True))
+        np.testing.assert_allclose(r["all_gather"], x)
+        np.testing.assert_allclose(r["reduce_scatter_gathered"], 4.0 * x)
+        np.testing.assert_allclose(r["all_to_all_gathered"], sq.T)
+        np.testing.assert_allclose(r["ppermute_gathered"],
+                                   np.roll(x, 1, axis=0))
+    assert [res["rank"] for res in results] == [0, 1]
+
+
+def test_zero3_multiprocess_matches_single_process():
+    """ZeRO-3 over 2 processes x 2 devices must train identically to one
+    process with the same 4-device global mesh — the sharding is the same
+    GSPMD program; only the process boundary differs."""
+    multi = run_distributed("zero3_train", nprocs=2, local_devices=2,
+                            args={"steps": 3})
+    single = run_distributed("zero3_train", nprocs=1, local_devices=4,
+                             args={"steps": 3})
+    l0 = multi[0]["result"]["losses"]
+    # rank-wise exact agreement (the loss is a replicated global scalar)
+    assert multi[1]["result"]["losses"] == l0
+    assert len(l0) == 3 and all(np.isfinite(l0))
+    np.testing.assert_allclose(l0, single[0]["result"]["losses"],
+                               rtol=0, atol=1e-5)
+    np.testing.assert_allclose(multi[0]["result"]["param_l2"],
+                               single[0]["result"]["param_l2"],
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("ckpt_engine", ["native", "orbax"])
+def test_checkpoint_multiprocess_roundtrip(tmp_path, ckpt_engine):
+    """Save from a 2-process world (collective host gather, process 0
+    writes / orbax multi-host), reload into a fresh 2-process engine, and
+    continue training with losses identical to the uninterrupted engine."""
+    results = run_distributed(
+        "checkpoint_roundtrip", nprocs=2, local_devices=2,
+        args={"save_dir": str(tmp_path / ckpt_engine),
+              "ckpt_engine": ckpt_engine})
+    r0 = results[0]["result"]
+    assert results[1]["result"] == r0  # rank-wise exact agreement
+    assert r0["step_loaded"] == 2
+    np.testing.assert_allclose(r0["norm_loaded"], r0["norm_at_save"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(r0["resumed"], r0["continued"],
+                               rtol=0, atol=1e-6)
