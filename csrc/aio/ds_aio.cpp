@@ -14,7 +14,9 @@
 //   aio_wait(handle, req)  /  aio_wait_all(handle)
 //   aio_handle_free(handle)
 
+#include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -31,6 +33,14 @@
 #include <unistd.h>
 #include <sys/stat.h>
 
+#ifdef __linux__
+#include <linux/io_uring.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#define DS_AIO_HAVE_URING 1
+#endif
+
 namespace {
 
 struct Request {
@@ -38,21 +48,191 @@ struct Request {
     std::function<int64_t()> work;
     std::atomic<bool> done{false};
     int64_t result{0};
+    // io_uring path (unused by the thread-pool backend):
+    int fd{-1};
+    bool owns_fd{false};
+    bool is_write{false};
+    char* base{nullptr};
+    int64_t count{0};
+    int64_t offset{0};
+    int64_t next{0};         // next unsubmitted byte (uring thread only)
+    int64_t bytes_done{0};
+    int err{0};              // first -errno seen
+    int chunks_inflight{0};  // uring thread only
+    bool eof{false};
 };
+
+#ifdef DS_AIO_HAVE_URING
+// Raw-syscall io_uring ring (no liburing in this image).  One ring + one
+// submitter/reaper thread per handle: submissions are batched (one
+// io_uring_enter flushes up to queue_depth SQEs — the reference's
+// deepspeed_aio_common.cpp submit-block model), completions resubmit short
+// transfers.  An eventfd POLL_ADD keeps the reaper wakeable for new work
+// while it blocks for completions.
+struct URingRing {
+    int ring_fd = -1;
+    int event_fd = -1;
+    unsigned sq_entries = 0, cq_entries = 0;
+    // sq ring
+    void* sq_ptr = nullptr;
+    size_t sq_len = 0;
+    unsigned* sq_head = nullptr;
+    unsigned* sq_tail = nullptr;
+    unsigned sq_mask = 0;
+    unsigned* sq_array = nullptr;
+    io_uring_sqe* sqes = nullptr;
+    size_t sqes_len = 0;
+    // cq ring
+    void* cq_ptr = nullptr;
+    size_t cq_len = 0;
+    unsigned* cq_head = nullptr;
+    unsigned* cq_tail = nullptr;
+    unsigned cq_mask = 0;
+    io_uring_cqe* cqes = nullptr;
+
+    static long sys_setup(unsigned entries, io_uring_params* p) {
+        return syscall(__NR_io_uring_setup, entries, p);
+    }
+    static long sys_enter(int fd, unsigned to_submit, unsigned min_complete,
+                          unsigned flags) {
+        return syscall(__NR_io_uring_enter, fd, to_submit, min_complete,
+                       flags, nullptr, 0);
+    }
+
+    bool init(unsigned entries) {
+        io_uring_params p;
+        memset(&p, 0, sizeof(p));
+        long fd = sys_setup(entries, &p);
+        if (fd < 0) return false;
+        ring_fd = (int)fd;
+        sq_entries = p.sq_entries;
+        cq_entries = p.cq_entries;
+        bool single_mmap = (p.features & IORING_FEAT_SINGLE_MMAP) != 0;
+        sq_len = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+        cq_len = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+        if (single_mmap) sq_len = cq_len = std::max(sq_len, cq_len);
+        sq_ptr = mmap(nullptr, sq_len, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, ring_fd, IORING_OFF_SQ_RING);
+        if (sq_ptr == MAP_FAILED) { teardown(); return false; }
+        cq_ptr = single_mmap ? sq_ptr
+                             : mmap(nullptr, cq_len, PROT_READ | PROT_WRITE,
+                                    MAP_SHARED | MAP_POPULATE, ring_fd,
+                                    IORING_OFF_CQ_RING);
+        if (cq_ptr == MAP_FAILED) { cq_ptr = nullptr; teardown(); return false; }
+        sqes_len = p.sq_entries * sizeof(io_uring_sqe);
+        sqes = (io_uring_sqe*)mmap(nullptr, sqes_len, PROT_READ | PROT_WRITE,
+                                   MAP_SHARED | MAP_POPULATE, ring_fd,
+                                   IORING_OFF_SQES);
+        if (sqes == MAP_FAILED) { sqes = nullptr; teardown(); return false; }
+        char* sq = (char*)sq_ptr;
+        sq_head = (unsigned*)(sq + p.sq_off.head);
+        sq_tail = (unsigned*)(sq + p.sq_off.tail);
+        sq_mask = *(unsigned*)(sq + p.sq_off.ring_mask);
+        sq_array = (unsigned*)(sq + p.sq_off.array);
+        char* cq = (char*)cq_ptr;
+        cq_head = (unsigned*)(cq + p.cq_off.head);
+        cq_tail = (unsigned*)(cq + p.cq_off.tail);
+        cq_mask = *(unsigned*)(cq + p.cq_off.ring_mask);
+        cqes = (io_uring_cqe*)(cq + p.cq_off.cqes);
+        event_fd = eventfd(0, EFD_NONBLOCK);
+        if (event_fd < 0) { teardown(); return false; }
+        return true;
+    }
+
+    unsigned sq_space() const {
+        unsigned head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
+        return sq_entries - (*sq_tail - head);
+    }
+
+    // Stage one SQE; caller flushes with enter().
+    void push_sqe(unsigned char opcode, int fd, void* addr, unsigned len,
+                  int64_t off, uint64_t user_data) {
+        unsigned tail = *sq_tail;
+        unsigned idx = tail & sq_mask;
+        io_uring_sqe* sqe = &sqes[idx];
+        memset(sqe, 0, sizeof(*sqe));
+        sqe->opcode = opcode;
+        sqe->fd = fd;
+        sqe->addr = (uint64_t)(uintptr_t)addr;
+        sqe->len = len;
+        sqe->off = (uint64_t)off;
+        sqe->user_data = user_data;
+        sq_array[idx] = idx;
+        __atomic_store_n(sq_tail, tail + 1, __ATOMIC_RELEASE);
+    }
+
+    void push_poll_eventfd(uint64_t user_data) {
+        unsigned tail = *sq_tail;
+        unsigned idx = tail & sq_mask;
+        io_uring_sqe* sqe = &sqes[idx];
+        memset(sqe, 0, sizeof(*sqe));
+        sqe->opcode = IORING_OP_POLL_ADD;
+        sqe->fd = event_fd;
+        sqe->poll_events = 1;  // POLLIN
+        sqe->user_data = user_data;
+        sq_array[idx] = idx;
+        __atomic_store_n(sq_tail, tail + 1, __ATOMIC_RELEASE);
+    }
+
+    bool pop_cqe(io_uring_cqe* out) {
+        unsigned head = *cq_head;
+        unsigned tail = __atomic_load_n(cq_tail, __ATOMIC_ACQUIRE);
+        if (head == tail) return false;
+        *out = cqes[head & cq_mask];
+        __atomic_store_n(cq_head, head + 1, __ATOMIC_RELEASE);
+        return true;
+    }
+
+    void wake() {
+        uint64_t one = 1;
+        ssize_t n = write(event_fd, &one, sizeof(one));
+        (void)n;
+    }
+
+    void teardown() {
+        if (sqes) munmap(sqes, sqes_len);
+        if (cq_ptr && cq_ptr != sq_ptr) munmap(cq_ptr, cq_len);
+        if (sq_ptr) munmap(sq_ptr, sq_len);
+        if (ring_fd >= 0) close(ring_fd);
+        if (event_fd >= 0) close(event_fd);
+        sqes = nullptr; cq_ptr = nullptr; sq_ptr = nullptr;
+        ring_fd = -1; event_fd = -1;
+    }
+};
+#endif  // DS_AIO_HAVE_URING
 
 struct Handle {
     size_t block_size;
     int queue_depth;  // max in-flight requests submitted per thread pass
     std::vector<std::thread> threads;
     std::deque<Request*> queue;
+    std::deque<Request*> uring_pending;
     std::unordered_map<int64_t, Request*> inflight;
     std::mutex mu;
     std::condition_variable cv_work;
     std::condition_variable cv_done;
     std::atomic<int64_t> next_id{1};
     bool stop{false};
+    bool use_uring{false};
+    bool uring_dead{false};  // ring thread exited on a catastrophic error
+#ifdef DS_AIO_HAVE_URING
+    URingRing ring;
+    std::thread uring_thread;
+#endif
 
-    explicit Handle(size_t bs, int qd, int threads_n) : block_size(bs), queue_depth(qd) {
+    explicit Handle(size_t bs, int qd, int threads_n, bool want_uring = false)
+        : block_size(bs), queue_depth(qd) {
+#ifdef DS_AIO_HAVE_URING
+        // ring entries = depth + 1 (the eventfd poll SQE rides alongside);
+        // the CHUNK concurrency contract is enforced by the slot table in
+        // uring_loop, which has exactly queue_depth entries
+        if (want_uring && ring.init((unsigned)std::max(qd + 1, 2))) {
+            use_uring = true;
+            uring_thread = std::thread([this] { uring_loop(); });
+            return;  // the ring thread replaces the pool
+        }
+#endif
+        (void)want_uring;
         for (int i = 0; i < threads_n; ++i) {
             threads.emplace_back([this] { worker(); });
         }
@@ -64,8 +244,16 @@ struct Handle {
             stop = true;
         }
         cv_work.notify_all();
+#ifdef DS_AIO_HAVE_URING
+        if (use_uring) {
+            ring.wake();
+            uring_thread.join();
+            ring.teardown();
+        }
+#endif
         for (auto& t : threads) t.join();
         for (auto* r : queue) delete r;
+        for (auto* r : uring_pending) delete r;
         for (auto& kv : inflight) delete kv.second;
     }
 
@@ -98,6 +286,210 @@ struct Handle {
         return req->id;
     }
 
+    // io_uring submission: (fd, buf, count, offset) chunked to block_size
+    // SQEs by the ring thread, up to queue_depth in flight.
+    int64_t submit_uring(int fd, bool owns_fd, bool is_write, void* buf,
+                         int64_t count, int64_t offset) {
+        auto* req = new Request();
+        req->id = next_id.fetch_add(1);
+        req->fd = fd;
+        req->owns_fd = owns_fd;
+        req->is_write = is_write;
+        req->base = (char*)buf;
+        req->count = count;
+        req->offset = offset;
+        {
+            std::lock_guard<std::mutex> lk(mu);
+            if (uring_dead) {
+                // the ring thread is gone: complete immediately with EIO so
+                // wait()/wait_all() cannot hang on a request nobody services
+                if (owns_fd && fd >= 0) close(fd);
+                req->result = -EIO;
+                req->done.store(true, std::memory_order_release);
+                inflight[req->id] = req;
+                return req->id;
+            }
+            inflight[req->id] = req;
+            uring_pending.push_back(req);
+        }
+#ifdef DS_AIO_HAVE_URING
+        ring.wake();
+#endif
+        return req->id;
+    }
+
+#ifdef DS_AIO_HAVE_URING
+    // One in-flight chunk: slot index == user_data.
+    struct Chunk {
+        Request* req = nullptr;
+        char* addr = nullptr;
+        unsigned len = 0;
+        int64_t off = 0;
+        bool in_use = false;
+    };
+
+    void uring_loop() {
+        const uint64_t POLL_UD = ~0ull;
+        std::vector<Chunk> slots((size_t)std::max(queue_depth, 1));
+        std::vector<size_t> free_slots;
+        for (size_t i = 0; i < slots.size(); ++i) free_slots.push_back(i);
+        std::deque<Request*> active;
+        std::deque<Chunk> retry;  // short transfers to resubmit
+        bool poll_armed = false;
+        size_t inflight_chunks = 0;
+        unsigned to_submit = 0;  // staged SQEs the kernel has not consumed
+
+        auto finish_if_done = [&](Request* r) {
+            if (r->next < r->count && r->err == 0 && !r->eof) return false;
+            if (r->chunks_inflight > 0) return false;
+            if (r->owns_fd && r->fd >= 0) close(r->fd);
+            int64_t res = r->err < 0 ? r->err : r->bytes_done;
+            {
+                std::lock_guard<std::mutex> lk(mu);
+                r->result = res;
+                r->done.store(true, std::memory_order_release);
+            }
+            cv_done.notify_all();
+            return true;
+        };
+
+        for (;;) {
+            {
+                std::lock_guard<std::mutex> lk(mu);
+                while (!uring_pending.empty()) {
+                    active.push_back(uring_pending.front());
+                    uring_pending.pop_front();
+                }
+                if (stop && active.empty() && retry.empty() &&
+                    inflight_chunks == 0)
+                    return;
+            }
+            // fill the submission queue: retries first, then fresh chunks
+            unsigned staged = 0;
+            auto stage = [&](Request* r, char* addr, unsigned len,
+                             int64_t off) {
+                size_t slot = free_slots.back();
+                free_slots.pop_back();
+                slots[slot] = Chunk{r, addr, len, off, true};
+                ring.push_sqe(r->is_write ? IORING_OP_WRITE : IORING_OP_READ,
+                              r->fd, addr, len, off, (uint64_t)slot);
+                r->chunks_inflight++;
+                inflight_chunks++;
+                staged++;
+            };
+            while (!retry.empty() && !free_slots.empty() &&
+                   ring.sq_space() > 1) {
+                Chunk c = retry.front();
+                retry.pop_front();
+                c.req->chunks_inflight--;  // re-staged below
+                inflight_chunks--;
+                stage(c.req, c.addr, c.len, c.off);
+            }
+            for (auto* r : active) {
+                while (r->next < r->count && r->err == 0 && !r->eof &&
+                       !free_slots.empty() && ring.sq_space() > 1) {
+                    unsigned len = (unsigned)std::min<int64_t>(
+                        (int64_t)block_size, r->count - r->next);
+                    stage(r, r->base + r->next, len, r->offset + r->next);
+                    r->next += len;
+                }
+                if (free_slots.empty() || ring.sq_space() <= 1) break;
+            }
+            if (!poll_armed && ring.sq_space() > 0) {
+                ring.push_poll_eventfd(POLL_UD);
+                staged++;
+                poll_armed = true;
+            }
+            // submit staged SQEs and block for >=1 completion when anything
+            // is in flight (batched submission = the queue-depth win)
+            to_submit += staged;
+            unsigned wait_n = (inflight_chunks > 0 || poll_armed) ? 1 : 0;
+            if (to_submit > 0 || wait_n > 0) {
+                long rc = URingRing::sys_enter(ring.ring_fd, to_submit,
+                                               wait_n,
+                                               IORING_ENTER_GETEVENTS);
+                if (rc >= 0) {
+                    to_submit -= (unsigned)rc;
+                } else if (errno != EINTR && errno != EBUSY) {
+                    // catastrophic ring failure: fail EVERYTHING — active,
+                    // already-queued, and (via uring_dead) anything submitted
+                    // later — so no wait()/wait_all() can hang on this handle
+                    int err = -errno;
+                    std::lock_guard<std::mutex> lk(mu);
+                    uring_dead = true;
+                    for (auto* r : active) {
+                        if (r->owns_fd && r->fd >= 0) close(r->fd);
+                        r->result = err;
+                        r->done.store(true, std::memory_order_release);
+                    }
+                    while (!uring_pending.empty()) {
+                        Request* r = uring_pending.front();
+                        uring_pending.pop_front();
+                        if (r->owns_fd && r->fd >= 0) close(r->fd);
+                        r->result = err;
+                        r->done.store(true, std::memory_order_release);
+                    }
+                    cv_done.notify_all();
+                    return;
+                }
+                // EINTR/EBUSY: SQEs stay staged; retried next pass
+            }
+            io_uring_cqe cqe;
+            while (ring.pop_cqe(&cqe)) {
+                if (cqe.user_data == POLL_UD) {
+                    uint64_t drain;
+                    while (read(ring.event_fd, &drain, sizeof(drain)) > 0) {}
+                    poll_armed = false;
+                    continue;
+                }
+                size_t slot = (size_t)cqe.user_data;
+                Chunk c = slots[slot];
+                slots[slot].in_use = false;
+                free_slots.push_back(slot);
+                Request* r = c.req;
+                r->chunks_inflight--;
+                inflight_chunks--;
+                if (cqe.res < 0) {
+                    if (r->err == 0) r->err = cqe.res;
+                } else if (cqe.res == 0 && !r->is_write) {
+                    r->eof = true;  // EOF: remaining bytes unreadable
+                } else if ((unsigned)cqe.res < c.len) {
+                    r->bytes_done += cqe.res;
+                    // short transfer: resubmit the remainder
+                    r->chunks_inflight++;
+                    inflight_chunks++;
+                    retry.push_back(Chunk{r, c.addr + cqe.res,
+                                          c.len - (unsigned)cqe.res,
+                                          c.off + cqe.res, true});
+                } else {
+                    r->bytes_done += cqe.res;
+                }
+            }
+            for (size_t i = 0; i < active.size();) {
+                if (finish_if_done(active[i])) {
+                    active.erase(active.begin() + (long)i);
+                } else {
+                    ++i;
+                }
+            }
+        }
+    }
+#endif  // DS_AIO_HAVE_URING
+
+    // Register an already-failed request so open() errors on the uring path
+    // surface through the normal wait() contract.
+    int64_t fail_request(int64_t err) {
+        auto* req = new Request();
+        req->id = next_id.fetch_add(1);
+        req->result = err;
+        req->done.store(true, std::memory_order_release);
+        {
+            std::lock_guard<std::mutex> lk(mu);
+            inflight[req->id] = req;
+        }
+        return req->id;
+    }
+
     int64_t wait(int64_t id) {
         Request* req = nullptr;
         {
@@ -116,7 +508,7 @@ struct Handle {
     int64_t wait_all() {
         std::unique_lock<std::mutex> lk(mu);
         cv_done.wait(lk, [this] {
-            if (!queue.empty()) return false;
+            if (!queue.empty() || !uring_pending.empty()) return false;
             for (auto& kv : inflight)
                 if (!kv.second->done.load(std::memory_order_acquire)) return false;
             return true;
@@ -216,12 +608,49 @@ void* aio_handle_new(int64_t block_size, int queue_depth, int thread_count) {
     return new Handle((size_t)block_size, queue_depth, thread_count);
 }
 
+// Backend-selectable constructor: use_uring=1 requests the io_uring engine
+// (batched submission at queue depth); silently falls back to the thread
+// pool when the kernel/container refuses (seccomp) — check with
+// aio_handle_backend.
+void* aio_handle_new2(int64_t block_size, int queue_depth, int thread_count,
+                      int use_uring) {
+    if (block_size <= 0) block_size = 1 << 20;
+    if (thread_count <= 0) thread_count = 1;
+    if (queue_depth <= 0) queue_depth = 8;
+    return new Handle((size_t)block_size, queue_depth, thread_count,
+                      use_uring != 0);
+}
+
+// 1 = io_uring, 0 = pthread pool.
+int aio_handle_backend(void* h) {
+    return static_cast<Handle*>(h)->use_uring ? 1 : 0;
+}
+
 void aio_handle_free(void* h) { delete static_cast<Handle*>(h); }
+
+static int open_for(const char* path, bool write, bool use_direct) {
+    int flags = write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+#ifdef O_DIRECT
+    if (use_direct) flags |= O_DIRECT;
+#endif
+    int fd = open(path, flags, 0644);
+#ifdef O_DIRECT
+    if (fd < 0 && use_direct)
+        fd = open(path, write ? (O_WRONLY | O_CREAT) : O_RDONLY, 0644);
+#endif
+    return fd < 0 ? -errno : fd;
+}
 
 // Async: returns request id (>0). Path strings are copied.
 int64_t aio_pread(void* h, const char* path, void* buf, int64_t count,
                   int64_t offset, int use_direct) {
     auto* handle = static_cast<Handle*>(h);
+    if (handle->use_uring) {
+        int fd = open_for(path, false, use_direct != 0);
+        if (fd < 0) return handle->fail_request(fd);
+        return handle->submit_uring(fd, /*owns_fd=*/true, /*is_write=*/false,
+                                    buf, count, offset);
+    }
     std::string p(path);
     size_t bs = handle->block_size;
     return handle->submit([p, buf, count, offset, use_direct, bs] {
@@ -232,6 +661,12 @@ int64_t aio_pread(void* h, const char* path, void* buf, int64_t count,
 int64_t aio_pwrite(void* h, const char* path, const void* buf, int64_t count,
                    int64_t offset, int use_direct) {
     auto* handle = static_cast<Handle*>(h);
+    if (handle->use_uring) {
+        int fd = open_for(path, true, use_direct != 0);
+        if (fd < 0) return handle->fail_request(fd);
+        return handle->submit_uring(fd, /*owns_fd=*/true, /*is_write=*/true,
+                                    const_cast<void*>(buf), count, offset);
+    }
     std::string p(path);
     size_t bs = handle->block_size;
     return handle->submit([p, buf, count, offset, use_direct, bs] {
@@ -300,6 +735,11 @@ int64_t aio_file_close(int64_t fd, int do_sync, int64_t truncate_to) {
 int64_t aio_fd_pwrite(void* h, int64_t fd, const void* buf, int64_t count,
                       int64_t offset) {
     auto* handle = static_cast<Handle*>(h);
+    if (handle->use_uring) {
+        return handle->submit_uring((int)fd, /*owns_fd=*/false,
+                                    /*is_write=*/true,
+                                    const_cast<void*>(buf), count, offset);
+    }
     size_t bs = handle->block_size;
     return handle->submit([fd, buf, count, offset, bs] {
         return do_fd_pwrite((int)fd, buf, count, offset, bs);
@@ -309,6 +749,10 @@ int64_t aio_fd_pwrite(void* h, int64_t fd, const void* buf, int64_t count,
 int64_t aio_fd_pread(void* h, int64_t fd, void* buf, int64_t count,
                      int64_t offset) {
     auto* handle = static_cast<Handle*>(h);
+    if (handle->use_uring) {
+        return handle->submit_uring((int)fd, /*owns_fd=*/false,
+                                    /*is_write=*/false, buf, count, offset);
+    }
     size_t bs = handle->block_size;
     return handle->submit([fd, buf, count, offset, bs] {
         return do_fd_pread((int)fd, buf, count, offset, bs);

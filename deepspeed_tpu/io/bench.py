@@ -91,17 +91,17 @@ def run(size_mb: int = 128) -> Dict[str, object]:
         def fast(writer):
             writer.save_trees([(model, mp), (opt, op)])
 
-        w_nosync = FastFileWriter(use_direct=False, fsync=False)
-        w_sync = FastFileWriter(use_direct=False, fsync=True)
-        t_native = _best(lambda: native(False), (mp, op))
-        t_fast = _best(lambda: fast(w_nosync), (mp, op))
-        # correctness: fast files load back identically
-        for tree, path in ((model, mp), (opt, op)):
-            loaded = load_file(path)
-            for k, v in tree.items():
-                np.testing.assert_array_equal(loaded[k], v)
-        t_native_d = _best(lambda: native(True), (mp, op))
-        t_fast_d = _best(lambda: fast(w_sync), (mp, op))
+        with FastFileWriter(use_direct=False, fsync=False) as w_nosync, \
+                FastFileWriter(use_direct=False, fsync=True) as w_sync:
+            t_native = _best(lambda: native(False), (mp, op))
+            t_fast = _best(lambda: fast(w_nosync), (mp, op))
+            # correctness: fast files load back identically
+            for tree, path in ((model, mp), (opt, op)):
+                loaded = load_file(path)
+                for k, v in tree.items():
+                    np.testing.assert_array_equal(loaded[k], v)
+            t_native_d = _best(lambda: native(True), (mp, op))
+            t_fast_d = _best(lambda: fast(w_sync), (mp, op))
 
         out.update({
             "native_s": round(t_native, 3),

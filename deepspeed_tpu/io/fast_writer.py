@@ -97,35 +97,37 @@ _ODIRECT_CACHE: Dict[str, bool] = {}
 def probe_o_direct(directory: str) -> bool:
     """Whether this filesystem accepts O_DIRECT (container overlayfs/tmpfs
     typically do not — and some accept the open but fail the first aligned
-    write).  Result cached per directory."""
+    write).  Result cached per directory; the probe's 1-thread pool lives
+    only for the probe (a leaked pool per distinct directory adds up in
+    long-running processes)."""
     directory = os.path.abspath(directory)
     cached = _ODIRECT_CACHE.get(directory)
     if cached is not None:
         return cached
     from ..nvme.aio_handle import AsyncIOHandle
 
-    h = AsyncIOHandle(thread_count=1)
     path = os.path.join(directory, f".odirect_probe_{os.getpid()}")
-    fd = None
     ok = False
-    try:
-        fd = h.open_write(path, use_direct=True)
-        buf = _aligned_buffer(_ALIGN)
-        req = h.fd_pwrite(fd, buf, _ALIGN, 0)
-        h.wait(req)
-        ok = True
-    except OSError:
-        ok = False
-    finally:
-        if fd is not None:
+    with AsyncIOHandle(thread_count=1) as h:
+        fd = None
+        try:
+            fd = h.open_write(path, use_direct=True)
+            buf = _aligned_buffer(_ALIGN)
+            req = h.fd_pwrite(fd, buf, _ALIGN, 0)
+            h.wait(req)
+            ok = True
+        except OSError:
+            ok = False
+        finally:
+            if fd is not None:
+                try:
+                    h.close_fd(fd, sync=False)
+                except OSError:
+                    pass
             try:
-                h.close(fd, sync=False)
+                os.unlink(path)
             except OSError:
                 pass
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
     _ODIRECT_CACHE[directory] = ok
     return ok
 
@@ -150,6 +152,18 @@ class FastFileWriter:
                                (stage_bytes + _ALIGN - 1) // _ALIGN * _ALIGN)
         self.fsync = fsync
         self.last_stats: Dict[str, float] = {}
+
+    def close(self) -> None:
+        """Release the native thread pool.  Ad-hoc writers (benches, tools)
+        must close; the shared ``get_fast_writer`` instance lives for the
+        process."""
+        self._aio.close()
+
+    def __enter__(self) -> "FastFileWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # -- mode selection -------------------------------------------------
     def _direct_for(self, path: str) -> bool:
@@ -203,8 +217,8 @@ class FastFileWriter:
                 err = err or e
         for fd in fds:
             try:
-                self._aio.close(fd, sync=self.fsync and err is None,
-                                truncate_to=truncate_to)
+                self._aio.close_fd(fd, sync=self.fsync and err is None,
+                                   truncate_to=truncate_to)
             except OSError as e:
                 err = err or e
         if err is not None:

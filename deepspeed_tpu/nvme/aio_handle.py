@@ -47,6 +47,11 @@ def _lib() -> ctypes.CDLL:
             lib = ctypes.CDLL(_build_library())
             lib.aio_handle_new.restype = ctypes.c_void_p
             lib.aio_handle_new.argtypes = [ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+            lib.aio_handle_new2.restype = ctypes.c_void_p
+            lib.aio_handle_new2.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                            ctypes.c_int, ctypes.c_int]
+            lib.aio_handle_backend.restype = ctypes.c_int
+            lib.aio_handle_backend.argtypes = [ctypes.c_void_p]
             lib.aio_handle_free.argtypes = [ctypes.c_void_p]
             for name in ("aio_pread", "aio_sync_pread"):
                 fn = getattr(lib, name)
@@ -93,20 +98,54 @@ class AsyncIOHandle:
     """
 
     def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
-                 thread_count: int = 1, use_direct: bool = False):
+                 thread_count: int = 1, use_direct: bool = False,
+                 backend: str = "threads"):
+        """``backend``: ``"threads"`` (pthread pool), ``"io_uring"``
+        (kernel submission queue at ``queue_depth`` — the reference's
+        libaio queue-depth model, ``csrc/aio/common/deepspeed_aio_common
+        .cpp``), or ``"auto"`` (io_uring when the kernel/container allows,
+        thread pool otherwise).  ``self.backend`` reports what was
+        actually constructed."""
+        if backend not in ("threads", "io_uring", "auto"):
+            raise ValueError(f"unknown aio backend {backend!r}")
         self._lib = _lib()
-        self._h = self._lib.aio_handle_new(block_size, queue_depth, thread_count)
+        want_uring = backend in ("io_uring", "auto")
+        self._h = self._lib.aio_handle_new2(block_size, queue_depth,
+                                            thread_count,
+                                            1 if want_uring else 0)
+        self.backend = ("io_uring"
+                        if self._lib.aio_handle_backend(self._h) else "threads")
+        if backend == "io_uring" and self.backend != "io_uring":
+            logger.warning(
+                "io_uring unavailable (kernel/seccomp) — using the thread "
+                "pool backend")
         self.use_direct = use_direct
         self.block_size = block_size
+        self.queue_depth = queue_depth
         self.thread_count = thread_count
         # keep buffers of in-flight requests alive
         self._pinned: dict[int, np.ndarray] = {}
 
+    def close(self) -> None:
+        """Join and release the C++ thread pool.  Idempotent — long-running
+        processes that create ad-hoc handles (probes, benches) must call
+        this (or use the handle as a context manager) so native threads
+        don't accumulate."""
+        h = getattr(self, "_h", None)
+        if h:
+            self._h = None
+            self._lib.aio_handle_free(h)
+            self._pinned.clear()
+
+    def __enter__(self) -> "AsyncIOHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def __del__(self):
         try:
-            if getattr(self, "_h", None):
-                self._lib.aio_handle_free(self._h)
-                self._h = None
+            self.close()
         except Exception:
             pass
 
@@ -161,7 +200,7 @@ class AsyncIOHandle:
             raise OSError(-fd, f"open {path}: {os.strerror(-fd)}")
         return fd
 
-    def close(self, fd: int, sync: bool = True, truncate_to: int = -1) -> None:
+    def close_fd(self, fd: int, sync: bool = True, truncate_to: int = -1) -> None:
         rc = self._lib.aio_file_close(fd, 1 if sync else 0, truncate_to)
         if rc < 0:
             raise OSError(-rc, f"close fd {fd}: {os.strerror(-rc)}")

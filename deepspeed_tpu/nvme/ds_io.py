@@ -41,6 +41,7 @@ class IOBenchResult:
     queue_depth: int
     thread_count: int
     use_direct: bool
+    backend: str = "threads"  # what actually ran ('io_uring' | 'threads')
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -59,43 +60,57 @@ def _make_file(path: str, nbytes: int) -> None:
 def run_bench(path: str, op: str = "read", size_mb: int = 256,
               block_size: int = 1 << 20, queue_depth: int = 8,
               thread_count: int = 4, use_direct: bool = False,
-              keep_file: bool = False,
-              overwrite: bool = False) -> IOBenchResult:
+              keep_file: bool = False, overwrite: bool = False,
+              backend: str = "threads", fsync: bool = False) -> IOBenchResult:
     """One measurement: stream ``size_mb`` through the AIO handle split into
-    queue_depth in-flight slices (the reference's single-process ds_io job)."""
+    queue_depth in-flight slices (the reference's single-process ds_io job).
+    ``fsync=True`` measures durable writes (what FastPersist competes on)."""
     nbytes = size_mb << 20
     handle = AsyncIOHandle(block_size=block_size, queue_depth=queue_depth,
-                           thread_count=thread_count, use_direct=use_direct)
-    created = False
-    if op == "read":
-        if not os.path.exists(path):
-            _make_file(path, nbytes)
-            created = True
-        elif os.path.getsize(path) < nbytes:
-            # a smaller file would short-read past EOF and report fantasy
-            # bandwidth; never overwrite a file we didn't create
-            raise ValueError(
-                f"{path} is {os.path.getsize(path)} bytes but the bench "
-                f"needs {nbytes}; point --path at a missing file (it will "
-                f"be created) or lower --size_mb")
-    elif os.path.exists(path) and not overwrite:
-        raise ValueError(
-            f"write bench refuses to overwrite existing {path}; point "
-            f"--path at a missing file")
-    buf = np.empty(nbytes, np.uint8)
-    slices = max(queue_depth, 1)
-    per = nbytes // slices
-    t0 = time.perf_counter()
-    reqs = []
-    for i in range(slices):
-        end = nbytes if i == slices - 1 else (i + 1) * per  # + remainder
-        view = buf[i * per:end]
+                           thread_count=thread_count, use_direct=use_direct,
+                           backend=backend)
+    try:
+        created = False
         if op == "read":
-            reqs.append(handle.pread(path, view, file_offset=i * per))
-        else:
-            reqs.append(handle.pwrite(path, view, file_offset=i * per))
-    handle.wait_all()
-    dt = time.perf_counter() - t0
+            if not os.path.exists(path):
+                _make_file(path, nbytes)
+                created = True
+            elif os.path.getsize(path) < nbytes:
+                # a smaller file would short-read past EOF and report fantasy
+                # bandwidth; never overwrite a file we didn't create
+                raise ValueError(
+                    f"{path} is {os.path.getsize(path)} bytes but the bench "
+                    f"needs {nbytes}; point --path at a missing file (it "
+                    f"will be created) or lower --size_mb")
+        elif os.path.exists(path) and not overwrite:
+            raise ValueError(
+                f"write bench refuses to overwrite existing {path}; point "
+                f"--path at a missing file")
+        buf = np.empty(nbytes, np.uint8)
+        slices = max(queue_depth, 1)
+        per = nbytes // slices
+        t0 = time.perf_counter()
+        reqs = []
+        for i in range(slices):
+            end = nbytes if i == slices - 1 else (i + 1) * per  # + remainder
+            view = buf[i * per:end]
+            if op == "read":
+                reqs.append(handle.pread(path, view, file_offset=i * per))
+            else:
+                reqs.append(handle.pwrite(path, view, file_offset=i * per))
+        handle.wait_all()
+        if op == "write" and fsync:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        dt = time.perf_counter() - t0
+        actual_backend = handle.backend
+    finally:
+        # sweeps tolerate per-point failures: the native pool/ring must not
+        # outlive this measurement either way
+        handle.close()
     if not keep_file and (op == "write" or created):
         try:
             os.unlink(path)
@@ -104,7 +119,7 @@ def run_bench(path: str, op: str = "read", size_mb: int = 256,
     return IOBenchResult(op=op, gbps=nbytes / dt / 1e9, seconds=dt,
                          size_bytes=nbytes, block_size=block_size,
                          queue_depth=queue_depth, thread_count=thread_count,
-                         use_direct=use_direct)
+                         use_direct=use_direct, backend=actual_backend)
 
 
 def run_sweep(dir_path: str, op: str = "read", size_mb: int = 128,
@@ -135,6 +150,44 @@ def run_sweep(dir_path: str, op: str = "read", size_mb: int = 128,
     except OSError:
         pass
     return sorted(results, key=lambda r: -r.gbps)
+
+
+def queue_depth_sweep(dir_path: str, op: str = "read", size_mb: int = 128,
+                      depths: Sequence[int] = (1, 2, 4, 8, 16, 32, 64),
+                      block_size: int = 1 << 20,
+                      backends: Sequence[str] = ("io_uring", "threads"),
+                      use_direct: bool = False,
+                      fsync: bool = False) -> List[IOBenchResult]:
+    """Throughput vs queue depth, per backend (reference:
+    ``csrc/aio/common/deepspeed_aio_common.cpp`` submits at configurable
+    queue depth; this sweep is the evidence that depth actually buys
+    bandwidth on the device at hand).  For the thread backend, thread count
+    scales with depth (its only concurrency lever); io_uring keeps ONE
+    submitter thread and scales in-kernel."""
+    os.makedirs(dir_path, exist_ok=True)
+    path = os.path.join(dir_path, "dstpu_io_qdsweep.dat")
+    if op == "read":
+        _make_file(path, size_mb << 20)
+    results: List[IOBenchResult] = []
+    for backend in backends:
+        for qd in depths:
+            tc = min(qd, 16) if backend == "threads" else 1
+            try:
+                r = run_bench(path, op=op, size_mb=size_mb,
+                              block_size=block_size, queue_depth=qd,
+                              thread_count=tc, use_direct=use_direct,
+                              keep_file=True, overwrite=True,
+                              backend=backend, fsync=fsync)
+            except OSError as e:
+                logger.warning(f"qd sweep point backend={backend} qd={qd} "
+                               f"failed: {e}")
+                continue
+            results.append(r)
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    return results
 
 
 def generate_aio_config(results: Sequence[IOBenchResult]) -> Dict:
@@ -171,12 +224,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     b.add_argument("--queue_depth", type=int, default=8)
     b.add_argument("--threads", type=int, default=4)
     b.add_argument("--direct", action="store_true")
+    b.add_argument("--backend", choices=["threads", "io_uring", "auto"],
+                   default="threads")
 
     s = sub.add_parser("sweep", help="grid sweep → recommended aio config")
     s.add_argument("--dir", default=tempfile.gettempdir())
     s.add_argument("--op", choices=["read", "write"], default="read")
     s.add_argument("--size_mb", type=int, default=128)
     s.add_argument("--direct", action="store_true")
+
+    q = sub.add_parser("qdsweep",
+                       help="throughput vs queue depth, io_uring vs threads")
+    q.add_argument("--dir", default=tempfile.gettempdir())
+    q.add_argument("--op", choices=["read", "write"], default="read")
+    q.add_argument("--size_mb", type=int, default=128)
+    q.add_argument("--block_size", type=int, default=1 << 20)
+    q.add_argument("--direct", action="store_true")
+    q.add_argument("--fsync", action="store_true",
+                   help="durable writes (fsync inside the timed window)")
 
     args = p.parse_args(argv)
     if not aio_available():
@@ -187,8 +252,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         r = run_bench(args.path, op=args.op, size_mb=args.size_mb,
                       block_size=args.block_size,
                       queue_depth=args.queue_depth,
-                      thread_count=args.threads, use_direct=args.direct)
+                      thread_count=args.threads, use_direct=args.direct,
+                      backend=getattr(args, "backend", "threads"))
         print(json.dumps(r.as_dict()))
+        return 0
+
+    if args.cmd == "qdsweep":
+        results = queue_depth_sweep(args.dir, op=args.op,
+                                    size_mb=args.size_mb,
+                                    block_size=args.block_size,
+                                    use_direct=args.direct, fsync=args.fsync)
+        for r in results:
+            print(f"  {r.backend:>8} qd={r.queue_depth:>3}: "
+                  f"{r.gbps:6.2f} GB/s")
+        print(json.dumps([r.as_dict() for r in results]))
         return 0
 
     results = run_sweep(args.dir, op=args.op, size_mb=args.size_mb,

@@ -53,8 +53,14 @@ class QuantizedWeight:
 
     def __post_init__(self):
         if self.k == 0:
-            kk = self.codes.shape[-2]
-            self.k = {8: kk, 4: kk * 2, 6: kk * 4 // 3}[self.bits]
+            if self.bits != 8:
+                # int4/fp6 pack K with padding, so the code-row count only
+                # bounds the true K (e.g. fp6 K=5 packs like K=8): inferring
+                # would silently report the padded K
+                raise ValueError(
+                    f"QuantizedWeight(bits={self.bits}) requires the true K "
+                    f"via k= (codes rows give only the padded K)")
+            self.k = self.codes.shape[-2]
 
     @property
     def k_features(self) -> int:

@@ -66,8 +66,11 @@ def hlo_collective_bytes(hlo_text: str) -> Dict[str, int]:
     """Result-shape bytes of every collective instruction, by op — an
     auditable proxy for wire volume (an all-gather's result is what the
     device receives; an all-reduce moves ~2x its shape on a ring, uniformly
-    for all schemes compared).  ``*-done`` lines are skipped so async pairs
-    count once."""
+    for all schemes compared).  Async pairs count once, at their ``*-done``
+    instruction: the done's result IS the collective's result, whereas the
+    ``*-start`` result is a backend-specific tuple of operand aliases,
+    results, and scalar context tokens whose layout a split-in-half
+    heuristic miscounts."""
     out: Dict[str, int] = collections.Counter()
     for line in hlo_text.splitlines():
         line = line.strip()
@@ -75,15 +78,11 @@ def hlo_collective_bytes(hlo_text: str) -> Dict[str, int]:
             # result shapes sit between '=' and the op call; the instruction
             # NAME left of '=' usually contains the op name too, so anchor
             # the search after '='
-            m = re.search(rf"=\s*(.*?)\b{coll}(-start)?(?:\.\d+)?\(", line)
-            if m is None:
+            m = re.search(rf"=\s*(.*?)\b{coll}(-start|-done)?(?:\.\d+)?\(",
+                          line)
+            if m is None or m.group(2) == "-start":
                 continue
             shapes = _SHAPE_RE.findall(m.group(1))
-            if m.group(2) and len(shapes) >= 2 and len(shapes) % 2 == 0:
-                # async start results are (operand-alias…, result…) tuples —
-                # count only the result half or the start form reads ~2x the
-                # sync form of the same collective
-                shapes = shapes[len(shapes) // 2:]
             nbytes = 0
             for dt, dims in shapes:
                 size = _DTYPE_BYTES.get(dt)

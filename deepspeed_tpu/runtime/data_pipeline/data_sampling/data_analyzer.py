@@ -175,7 +175,8 @@ class DistributedDataAnalyzer:
     def __init__(self, dataset, metric_fns: Dict[str, MetricFn],
                  save_path: str, rank: Optional[int] = None,
                  world_size: Optional[int] = None,
-                 metric_types: Optional[Dict[str, str]] = None):
+                 metric_types: Optional[Dict[str, str]] = None,
+                 run_id: Optional[str] = None):
         self.dataset = dataset
         self.metric_fns = dict(metric_fns)
         self.save_path = save_path
@@ -183,6 +184,11 @@ class DistributedDataAnalyzer:
         self.world_size = (int(os.environ.get("WORLD_SIZE", 1))
                            if world_size is None else world_size)
         self.metric_types = dict(metric_types or {})
+        # the run id travels by argument; the env var is only the cross-
+        # process channel (launcher/spawn_local workers), read once here so
+        # concurrent sweeps in one process can't cross-contaminate ids
+        self.run_id = (run_id if run_id is not None
+                       else os.environ.get("DSTPU_ANALYZER_RUN_ID"))
         os.makedirs(save_path, exist_ok=True)
 
     def _rank_path(self, metric: str, rank: int) -> str:
@@ -204,9 +210,8 @@ class DistributedDataAnalyzer:
         # provides a run id (spawn_local always does; multi-host runs set
         # DSTPU_ANALYZER_RUN_ID on every rank), stale sentinels from the
         # previous run fail the match instead of silently merging old files
-        run_id = os.environ.get("DSTPU_ANALYZER_RUN_ID")
-        if run_id:
-            out["run_id"] = run_id
+        if self.run_id:
+            out["run_id"] = self.run_id
         return out
 
     def run_map_local(self) -> None:
@@ -307,11 +312,16 @@ class DistributedDataAnalyzer:
                     metric_fns_factory, "--save-path", save_path]
         if metric_types:
             cmd_tail += ["--metric-types", json.dumps(metric_types)]
+        # the run id reaches workers via their OWN env dicts and the reducer
+        # via its constructor — never through the parent's process-global
+        # os.environ (concurrent sweeps in one process would cross-
+        # contaminate ids and could mis-validate sentinels)
         run_id = uuid.uuid4().hex
-        prior = os.environ.get("DSTPU_ANALYZER_RUN_ID")
-        os.environ["DSTPU_ANALYZER_RUN_ID"] = run_id  # reducer expects it
         procs = []
         try:
+            # spawns stay INSIDE the try: a mid-loop Popen failure (fd
+            # exhaustion) must still kill the workers already started, or
+            # they write into a retried save_path unsupervised
             for r in range(num_procs):
                 env = dict(os.environ, RANK=str(r),
                            WORLD_SIZE=str(num_procs), JAX_PLATFORMS="cpu",
@@ -321,24 +331,18 @@ class DistributedDataAnalyzer:
                      "deepspeed_tpu.runtime.data_pipeline.data_sampling"
                      ".data_analyzer", *cmd_tail],
                     env=env))
-            try:
-                rcs = [p.wait(timeout=timeout_s) for p in procs]
-            finally:
-                for p in procs:  # a hung worker must not outlive the sweep
-                    if p.poll() is None:  # and write into a retried path
-                        p.kill()
-            if any(rcs):
-                raise RuntimeError(f"analyzer workers failed: rcs={rcs}")
-            dataset = _resolve_factory(dataset_factory)()
-            metrics = _resolve_factory(metric_fns_factory)()
-            return DistributedDataAnalyzer(
-                dataset, metrics, save_path, rank=0, world_size=num_procs,
-                metric_types=metric_types).run_reduce(timeout_s)
+            rcs = [p.wait(timeout=timeout_s) for p in procs]
         finally:
-            if prior is None:
-                os.environ.pop("DSTPU_ANALYZER_RUN_ID", None)
-            else:
-                os.environ["DSTPU_ANALYZER_RUN_ID"] = prior
+            for p in procs:  # a hung worker must not outlive the sweep
+                if p.poll() is None:  # and write into a retried path
+                    p.kill()
+        if any(rcs):
+            raise RuntimeError(f"analyzer workers failed: rcs={rcs}")
+        dataset = _resolve_factory(dataset_factory)()
+        metrics = _resolve_factory(metric_fns_factory)()
+        return DistributedDataAnalyzer(
+            dataset, metrics, save_path, rank=0, world_size=num_procs,
+            metric_types=metric_types, run_id=run_id).run_reduce(timeout_s)
 
 
 def _resolve_factory(spec: str):

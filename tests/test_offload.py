@@ -172,3 +172,70 @@ def test_ds_io_cli(tmp_path, capsys):
     out = capsys.readouterr().out.strip().splitlines()[-1]
     d = _json.loads(out)
     assert d["op"] == "write" and d["gbps"] > 0
+
+
+# ---------------------------------------------------------------------------
+# io_uring backend (reference: libaio queue-depth submission,
+# csrc/aio/common/deepspeed_aio_common.cpp)
+# ---------------------------------------------------------------------------
+
+
+def _uring_available() -> bool:
+    h = AsyncIOHandle(backend="auto")
+    try:
+        return h.backend == "io_uring"
+    finally:
+        h.close()
+
+
+@pytest.mark.parametrize("backend", ["threads", "io_uring"])
+def test_aio_backend_roundtrip(tmp_path, backend):
+    if backend == "io_uring" and not _uring_available():
+        pytest.skip("io_uring unavailable (kernel/seccomp)")
+    with AsyncIOHandle(block_size=1 << 16, queue_depth=16,
+                       backend=backend) as h:
+        assert h.backend == backend
+        data = np.random.default_rng(1).integers(
+            0, 255, 3 * (1 << 16) + 123, dtype=np.uint8)  # non-block-multiple
+        path = str(tmp_path / "t.bin")
+        assert h.wait(h.pwrite(path, data)) == data.nbytes
+        out = np.empty_like(data)
+        assert h.wait(h.pread(path, out)) == data.nbytes
+        np.testing.assert_array_equal(out, data)
+        # fd API: concurrent chunk writes at offsets through one fd
+        fd = h.open_write(str(tmp_path / "t2.bin"))
+        quarter = data.nbytes // 4
+        reqs = [h.fd_pwrite(fd, data[i * quarter:(i + 1) * quarter].copy(),
+                            quarter, i * quarter) for i in range(4)]
+        for r in reqs:
+            assert h.wait(r) == quarter
+        h.close_fd(fd)
+        # error surface: missing file
+        with pytest.raises(OSError):
+            h.wait(h.pread(str(tmp_path / "missing"), out))
+
+
+def test_aio_uring_short_file_read_stops_at_eof(tmp_path):
+    if not _uring_available():
+        pytest.skip("io_uring unavailable")
+    with AsyncIOHandle(block_size=1 << 12, queue_depth=8,
+                       backend="io_uring") as h:
+        payload = np.arange(5000, dtype=np.uint8)  # 5000 B file
+        path = str(tmp_path / "short.bin")
+        h.wait(h.pwrite(path, payload))
+        buf = np.zeros(16384, np.uint8)  # ask for more than exists
+        n = h.wait(h.pread(path, buf))
+        assert n == 5000
+        np.testing.assert_array_equal(buf[:5000], payload)
+
+
+def test_queue_depth_sweep_runs(tmp_path):
+    from deepspeed_tpu.nvme.ds_io import queue_depth_sweep
+
+    results = queue_depth_sweep(str(tmp_path), op="write", size_mb=8,
+                                depths=(1, 4), fsync=False)
+    assert len(results) >= 2
+    backends = {r.backend for r in results}
+    assert "threads" in backends  # io_uring may be seccomp-blocked
+    for r in results:
+        assert r.gbps > 0

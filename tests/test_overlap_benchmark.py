@@ -83,12 +83,24 @@ def test_multichip_compile_evidence(devices):
             or "reduce-scatter" in ev["collectives"]), ev
 
 
-def test_hlo_collective_bytes_async_tuple_counts_result_half():
-    """*-start results are (alias..., result...) tuples — only the result
-    half may count, or async forms read ~2x their sync equivalents."""
+def test_hlo_collective_bytes_async_counts_at_done():
+    """*-start results are backend-specific tuples (operand aliases,
+    results, scalar context tokens) — async pairs count once, at the *-done
+    whose result IS the collective result, so asymmetric start layouts
+    cannot skew the tally."""
     from deepspeed_tpu.profiling.compile_evidence import hlo_collective_bytes
 
     sync = "x = f32[1024]{0} all-reduce(y), replica_groups={}"
-    asy = "x = (f32[1024]{0}, f32[1024]{0}) all-reduce-start(y), dims={}"
     assert hlo_collective_bytes(sync)["all-reduce"] == 4096
-    assert hlo_collective_bytes(asy)["all-reduce"] == 4096
+    pair = "\n".join([
+        # start tuple with an ODD component count (context token) — the
+        # halving heuristic this replaces would have miscounted it
+        "x = (f32[1024]{0}, f32[1024]{0}, u32[]) all-reduce-start(y)",
+        "z = f32[1024]{0} all-reduce-done(x)",
+    ])
+    assert hlo_collective_bytes(pair)["all-reduce"] == 4096
+    ag = "\n".join([
+        "a = (bf16[4]{0}, bf16[16]{0}) all-gather-start(b), dims={0}",
+        "c = bf16[16]{0} all-gather-done(a)",
+    ])
+    assert hlo_collective_bytes(ag)["all-gather"] == 32
