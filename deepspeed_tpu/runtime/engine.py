@@ -20,7 +20,7 @@ from __future__ import annotations
 import collections.abc
 import dataclasses
 import time
-from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -924,6 +924,7 @@ class TrainingEngine:
         from .data_pipeline.loader import PlacedBatch
 
         self._assert_streaming_flag()
+        self.reload_states()  # states evicted by offload_states() come back
         if self.config.trace_profiler.enabled:
             self._maybe_trace(starting=True)
         self.tput.start()
@@ -1108,6 +1109,9 @@ class TrainingEngine:
         from .data_pipeline.loader import PlacedBatch
 
         self._assert_streaming_flag()
+        # eval needs the params only — optimizer moments evicted for a
+        # rollout phase (hybrid engine) STAY on the host
+        self.reload_states(include=("lp_params",))
         self.flush_delayed_update()
         if isinstance(batch, PlacedBatch):  # prefetched validation loops
             placed = batch.placed
@@ -1165,6 +1169,97 @@ class TrainingEngine:
 
         return _load(self, load_dir, tag=tag,
                      load_optimizer_states=load_optimizer_states)
+
+    # -- phase-alternation state offload (reference: engine.py:5573
+    # offload_states / reload_states — RLHF rollouts evict optimizer state
+    # to free HBM for the KV cache, then reload before the next update) ---
+
+    _OFFLOADABLE = ("optim_states", "lp_params")
+
+    def offload_states(self, include: Optional[Sequence[str]] = None,
+                       device: str = "cpu", pin_memory: bool = True,
+                       non_blocking: bool = False) -> None:
+        """Evict engine state to host memory between phases.
+
+        ``include`` ⊆ {"optim_states", "lp_params"} (default: optimizer
+        states only — evicting the compute params too means nothing can run
+        until :meth:`reload_states`).  Device buffers are deleted after the
+        host copy, so HBM is actually freed, not just mirrored.  With
+        ``offload_optimizer`` the optimizer already lives on the host and
+        "optim_states" is a no-op.  Idempotent; ``train_batch`` reloads
+        automatically."""
+        if device != "cpu":
+            raise ConfigError(f"offload_states supports device='cpu', "
+                              f"got {device!r}")
+        include = set(include) if include is not None else {"optim_states"}
+        unknown = include - set(self._OFFLOADABLE)
+        if unknown:
+            raise ConfigError(
+                f"offload_states: unknown state types {sorted(unknown)}; "
+                f"valid: {self._OFFLOADABLE}")
+        self.flush_delayed_update()
+
+        def evict(tree):
+            shardings = jax.tree.map(
+                lambda x: x.sharding if isinstance(x, jax.Array) else None,
+                tree)
+            host = jax.device_get(tree)
+            jax.tree.map(
+                lambda x: x.delete() if isinstance(x, jax.Array) else None,
+                tree)
+            return host, shardings
+
+        offloaded = getattr(self, "_offloaded_states", None) or {}
+        if ("optim_states" in include and "optim_states" not in offloaded
+                and self.offloaded_optimizer is None):
+            host, sh = evict(self.state.opt_state)
+            self.state = dataclasses.replace(self.state, opt_state=host)
+            offloaded["optim_states"] = sh
+        if "lp_params" in include and "lp_params" not in offloaded:
+            host, sh = evict(self.state.params)
+            self.state = dataclasses.replace(self.state, params=host)
+            offloaded["lp_params"] = sh
+        self._offloaded_states = offloaded
+        if offloaded:
+            log_dist(f"offloaded states to host: {sorted(offloaded)}")
+
+    def reload_states(self, non_blocking: bool = False,
+                      include: Optional[Sequence[str]] = None) -> None:
+        """Restore states evicted by :meth:`offload_states` onto their
+        original shardings.  ``include=None`` restores everything; a subset
+        restores only those kinds and leaves the rest on the host (eval
+        during an RLHF rollout needs params, not optimizer moments).
+        Idempotent."""
+        offloaded = getattr(self, "_offloaded_states", None)
+        if not offloaded:
+            return
+        wanted = set(include) if include is not None else set(offloaded)
+
+        def restore(tree, shardings):
+            return jax.tree.map(
+                lambda x, s: jax.device_put(x, s) if s is not None else x,
+                tree, shardings)
+
+        restored = []
+        if "optim_states" in offloaded and "optim_states" in wanted:
+            self.state = dataclasses.replace(
+                self.state,
+                opt_state=restore(self.state.opt_state,
+                                  offloaded.pop("optim_states")))
+            restored.append("optim_states")
+        if "lp_params" in offloaded and "lp_params" in wanted:
+            self.state = dataclasses.replace(
+                self.state,
+                params=restore(self.state.params,
+                               offloaded.pop("lp_params")))
+            restored.append("lp_params")
+        self._offloaded_states = offloaded or None
+        if restored:
+            log_dist(f"reloaded host-offloaded states: {sorted(restored)}")
+
+    @property
+    def states_offloaded(self) -> bool:
+        return bool(getattr(self, "_offloaded_states", None))
 
 
 def _stop_trace_at_exit(engine_ref) -> None:

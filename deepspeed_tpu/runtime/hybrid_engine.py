@@ -79,6 +79,10 @@ class HybridEngine:
 
     def generate(self, prompts: List[List[int]], max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0) -> List[List[int]]:
+        # rollout phase: optimizer moments are dead weight in HBM while the
+        # KV pool grows — evict them (reference engine.py:5573
+        # offload_states); the next train_batch reloads automatically
+        self.trainer.offload_states(include=("optim_states",))
         eng = self._inference_engine()
         uids = [eng.put(p, max_new_tokens=max_new_tokens) for p in prompts]
         results = eng.generate_all(temperature=temperature, seed=seed)

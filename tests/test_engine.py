@@ -273,3 +273,53 @@ def test_sanity_checks_detect_replica_divergence(devices):
         leaf.shape, leaf.sharding, parts)
     engine.state.params["embed"]["tokens"] = forged
     assert engine._replica_consistency_violations() != []
+
+
+def test_offload_reload_states(devices):
+    """offload_states evicts optimizer state (and optionally params) to the
+    host and frees the device buffers; reload (explicit or the automatic one
+    in train/eval_batch) restores the exact training trajectory.  Reference:
+    engine.py:5573 offload_states."""
+    import jax
+
+    cfg = dict(BASE, zero_optimization={"stage": 2})
+    spec = tiny_lm_spec()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config=cfg)
+    rng = np.random.default_rng(0)
+    batches = [copy_task_batch(rng, engine.train_batch_size, 32)
+               for _ in range(4)]
+    losses = [float(engine.train_batch(b)["loss"]) for b in batches[:2]]
+
+    engine.offload_states()  # default: optim_states
+    assert engine.states_offloaded
+    opt_leaves = [l for l in jax.tree.leaves(engine.state.opt_state)
+                  if hasattr(l, "dtype")]
+    assert all(isinstance(l, np.ndarray) for l in opt_leaves)
+    # params still live on device — eval works without a reload of them
+    engine.offload_states(include=("lp_params",))
+    p_leaves = jax.tree.leaves(engine.state.params)
+    assert all(isinstance(l, np.ndarray) for l in p_leaves)
+
+    engine.reload_states()
+    assert not engine.states_offloaded
+    assert all(isinstance(l, jax.Array)
+               for l in jax.tree.leaves(engine.state.params))
+
+    # trajectory unbroken vs an uninterrupted engine
+    ref, _, _, _ = deepspeed_tpu.initialize(model=tiny_lm_spec(), config=cfg)
+    ref_losses = [float(ref.train_batch(b)["loss"]) for b in batches[:2]]
+    np.testing.assert_allclose(losses, ref_losses, rtol=0, atol=0)
+    engine.offload_states()  # auto-reload inside train_batch
+    cont = [float(engine.train_batch(b)["loss"]) for b in batches[2:]]
+    ref_cont = [float(ref.train_batch(b)["loss"]) for b in batches[2:]]
+    np.testing.assert_allclose(cont, ref_cont, rtol=0, atol=1e-6)
+
+
+def test_offload_states_rejects_unknown(devices):
+    from deepspeed_tpu.runtime.config_utils import ConfigError
+
+    cfg = dict(BASE)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_lm_spec(),
+                                               config=cfg)
+    with pytest.raises(ConfigError):
+        engine.offload_states(include=("hp_params_nope",))
