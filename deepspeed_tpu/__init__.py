@@ -54,7 +54,26 @@ def initialize(model: Union[ModelSpec, Any] = None,
 
 
 def init_inference(model=None, config=None, **kwargs):
-    """Reference: ``deepspeed.init_inference`` (``__init__.py:328``)."""
+    """Reference: ``deepspeed.init_inference`` (``__init__.py:328``).
+
+    Decoder models get the KV-cache engine; encoder configs
+    (:class:`models.encoder.EncoderConfig`) get the bidirectional
+    :class:`EncoderInferenceEngine`."""
+    from .models.encoder import EncoderConfig
+
+    mc = kwargs.get("model_config")
+    if isinstance(mc, EncoderConfig):
+        from .inference.engine import EncoderInferenceEngine
+
+        kwargs.pop("model_config")
+        params = kwargs.pop("params", None)
+        if params is None and model is not None and hasattr(model, "params"):
+            params = model.params  # ModelSpec-style bundle, decoder parity
+        if params is None:
+            raise ValueError(
+                "encoder inference needs the param pytree: pass params= "
+                "(e.g. from load_hf_model) or a model bundle with .params")
+        return EncoderInferenceEngine(mc, params, config=config, **kwargs)
     from .inference.engine import InferenceEngine
 
     return InferenceEngine(model=model, config=config, **kwargs)

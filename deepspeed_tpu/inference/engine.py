@@ -59,6 +59,8 @@ def forward_cached(params, tokens, cache, start_pos, cfg: tfm.TransformerConfig)
     if cfg.position == "learned":
         pos_ids = start_pos + jnp.arange(T)
         x = x + params["embed"]["position"].astype(dt)[pos_ids][None]
+    if cfg.embed_norm:  # bloom word_embeddings_layernorm
+        x = tfm._norm(x, params["embed_norm"], "layernorm", cfg.norm_eps)
     cos_full, sin_full = (None, None)
     if cfg.position == "rope":
         cos_full, sin_full = tfm.rope_table(max_len, cfg.rot_dim, cfg.rope_theta)
@@ -94,6 +96,11 @@ def forward_cached(params, tokens, cache, start_pos, cfg: tfm.TransformerConfig)
         logits = logits.astype(jnp.float32)
         key_pos = jnp.arange(max_len)[None, None, None, :]
         qry_pos = (start_pos + jnp.arange(T))[None, None, :, None]
+        if cfg.position == "alibi":
+            # slope · key-position, identical to the training-side formulation
+            # (per-query-row constants cancel in softmax)
+            logits = logits + tfm.alibi_slopes(nh)[None, :, None, None] * \
+                key_pos.astype(jnp.float32)
         mask = key_pos <= qry_pos
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(dt)
@@ -212,3 +219,66 @@ class InferenceEngine:
         if temperature <= 0.0:
             return logits.argmax(-1).astype(jnp.int32)
         return jax.random.categorical(rng, logits / temperature).astype(jnp.int32)
+
+
+class EncoderInferenceEngine:
+    """Encoder-model serving (BERT family) — the reference's encoder
+    kernel-injection path (``module_inject/containers/bert.py:30``).
+
+    No KV cache or decode loop: one jitted bidirectional forward, TP-sharded
+    by the encoder's logical axes.  ``encode()`` returns hidden states,
+    ``mlm_logits()`` the masked-LM head, ``pooled()`` the [CLS] pooler."""
+
+    def __init__(self, model_config, params, config=None, **kwargs):
+        from ..models import encoder as enc
+
+        if isinstance(config, dict):
+            icfg = InferenceConfig(**{k: v for k, v in config.items()
+                                      if k in InferenceConfig.__dataclass_fields__})
+        elif isinstance(config, InferenceConfig):
+            icfg = config
+        else:
+            icfg = InferenceConfig()
+        self.config = icfg
+        self.model_config = dataclasses.replace(model_config, dtype=icfg.dtype)
+        self._enc = enc
+        self.topo = MeshTopology.from_config(
+            MeshConfig(tensor_parallel_size=icfg.tensor_parallel_size))
+        rules = rules_for_params(0, self.topo)
+        shardings = sharding_for_tree(
+            params, enc.param_axes(self.model_config, params=params),
+            rules, self.topo)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s), params, shardings)
+        cfg = self.model_config
+        self._encode = jax.jit(partial(enc.encode, cfg=cfg))
+        self._mlm = jax.jit(partial(enc.mlm_logits, cfg=cfg))
+        self._pooled = (jax.jit(partial(enc.pooled_output, cfg=cfg))
+                        if "pooler" in params else None)
+
+    def _args(self, input_ids, attention_mask, token_type_ids):
+        ids = jnp.asarray(input_ids, jnp.int32)
+        am = None if attention_mask is None else jnp.asarray(attention_mask)
+        tt = None if token_type_ids is None else jnp.asarray(token_type_ids,
+                                                             jnp.int32)
+        return ids, am, tt
+
+    def encode(self, input_ids, attention_mask=None, token_type_ids=None):
+        ids, am, tt = self._args(input_ids, attention_mask, token_type_ids)
+        return np.asarray(self._encode(self.params, ids,
+                                       attention_mask=am, token_type_ids=tt))
+
+    def mlm_logits(self, input_ids, attention_mask=None, token_type_ids=None):
+        if "mlm" not in self.params:
+            raise ValueError("model has no MLM head (converted from a bare "
+                             "BertModel?)")
+        ids, am, tt = self._args(input_ids, attention_mask, token_type_ids)
+        return np.asarray(self._mlm(self.params, ids,
+                                    attention_mask=am, token_type_ids=tt))
+
+    def pooled(self, input_ids, attention_mask=None, token_type_ids=None):
+        if self._pooled is None:
+            raise ValueError("model has no pooler")
+        ids, am, tt = self._args(input_ids, attention_mask, token_type_ids)
+        return np.asarray(self._pooled(self.params, ids,
+                                       attention_mask=am, token_type_ids=tt))

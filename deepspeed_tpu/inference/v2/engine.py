@@ -114,6 +114,9 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
         x = params["embed"]["tokens"].astype(dt)[token_ids]  # (T, H)
         if model_cfg.position == "learned":
             x = x + params["embed"]["position"].astype(dt)[position_ids]
+        if model_cfg.embed_norm:
+            x = tfm._norm(x, params["embed_norm"], "layernorm",
+                          model_cfg.norm_eps)
         cos_full, sin_full = (None, None)
         if model_cfg.position == "rope":
             max_len = v2.max_blocks_per_seq * bs
@@ -260,6 +263,8 @@ def _decode_body(params, caches, token_ids, position_ids, block_tables,
     x = params["embed"]["tokens"].astype(dt)[token_ids]
     if model_cfg.position == "learned":
         x = x + params["embed"]["position"].astype(dt)[position_ids]
+    if model_cfg.embed_norm:
+        x = tfm._norm(x, params["embed_norm"], "layernorm", model_cfg.norm_eps)
     cos_full, sin_full = (None, None)
     if model_cfg.position == "rope":
         max_len = v2.max_blocks_per_seq * bs
@@ -339,6 +344,11 @@ class InferenceEngineV2:
                 "expert_choice routing is non-causal — continuous-batching "
                 "decode with it would route across unrelated requests; "
                 "serve with moe_routing='capacity' or 'dropless'")
+        if getattr(model_config, "position", "rope") == "alibi":
+            raise NotImplementedError(
+                "v2's paged Pallas attention takes no additive logit bias "
+                "yet — serve ALiBi models (bloom) through the v1 engine "
+                "(deepspeed_tpu.init_inference), which supports alibi")
         self.cfg = config or V2Config()
         self.model_cfg = dataclasses.replace(model_config, dtype=self.cfg.dtype)
         self.params = params

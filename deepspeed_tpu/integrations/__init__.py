@@ -1,0 +1,7 @@
+"""Framework integrations (HF Trainer contract)."""
+
+from .hf_args import config_from_training_args, resolve_auto_config
+from .trainer import Trainer, TrainerState, TrainOutput
+
+__all__ = ["Trainer", "TrainerState", "TrainOutput",
+           "config_from_training_args", "resolve_auto_config"]

@@ -1,0 +1,248 @@
+"""BERT-family encoder (bidirectional, post-LN) — the encoder path of the
+model zoo.
+
+Capability analogue of the reference's encoder support
+(``module_inject/containers/bert.py:30`` kernel-injection policy and the
+``inference/v2`` encoder configs): BERT-style models run through the same
+TPU-first machinery as the decoders — stacked-and-scanned layers, logical
+axes for ZeRO/TP sharding, pluggable XLA attention — with the three
+architectural differences encoders bring:
+
+* **bidirectional attention** with a key-side padding mask (no causal mask);
+* **post-layernorm residuals**: ``x = LN(x + sublayer(x))`` (original BERT),
+  vs the decoders' pre-LN;
+* **summed embeddings** (word + position + token-type) normalized once.
+
+The MLM head (dense → GELU → LN → tied decoder + bias) and the tanh pooler
+are included so ``BertForMaskedLM`` converts token-exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    activation: str = "gelu_exact"  # BERT uses erf-form GELU
+    dtype: str = "float32"
+    param_dtype: str = "float32"
+    remat_policy: str = "nothing_saveable"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        h, f, L = self.hidden_size, self.intermediate_size, self.num_layers
+        per_layer = 4 * h * h + 2 * h * f + (4 + 2 + f + h) + 4 * h
+        embed = (self.vocab_size + self.max_seq_len + self.type_vocab_size) * h
+        return L * per_layer + embed + 2 * h
+
+
+from .transformer import _dense_init as _dense  # shared init (one home)
+
+
+def init_params(rng: jax.Array, cfg: EncoderConfig) -> Dict[str, Any]:
+    pd = jnp.dtype(cfg.param_dtype)
+    h, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    k = jax.random.split(rng, 12)
+    zeros = lambda *s: jnp.zeros(s, pd)  # noqa: E731
+    ones = lambda *s: jnp.ones(s, pd)  # noqa: E731
+    layer = {
+        "attn": {
+            "wq": _dense(k[0], (L, h, h), h, pd), "bq": zeros(L, h),
+            "wk": _dense(k[1], (L, h, h), h, pd), "bk": zeros(L, h),
+            "wv": _dense(k[2], (L, h, h), h, pd), "bv": zeros(L, h),
+            "wo": _dense(k[3], (L, h, h), h, pd), "bo": zeros(L, h),
+        },
+        "ln_attn": {"scale": ones(L, h), "bias": zeros(L, h)},
+        "mlp": {
+            "w_in": _dense(k[4], (L, h, f), h, pd), "b_in": zeros(L, f),
+            "w_out": _dense(k[5], (L, f, h), f, pd), "b_out": zeros(L, h),
+        },
+        "ln_mlp": {"scale": ones(L, h), "bias": zeros(L, h)},
+    }
+    return {
+        "embed": {
+            "tokens": _dense(k[6], (cfg.vocab_size, h), h, pd),
+            "position": _dense(k[7], (cfg.max_seq_len, h), h, pd),
+            "token_type": _dense(k[8], (cfg.type_vocab_size, h), h, pd),
+        },
+        "embed_norm": {"scale": ones(h), "bias": zeros(h)},
+        "layers": layer,
+        "mlm": {
+            "w": _dense(k[9], (h, h), h, pd), "b": zeros(h),
+            "norm": {"scale": ones(h), "bias": zeros(h)},
+            "decoder_bias": zeros(cfg.vocab_size),
+        },
+        "pooler": {"w": _dense(k[10], (h, h), h, pd), "b": zeros(h)},
+    }
+
+
+def param_axes(cfg: EncoderConfig,
+               params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Logical axes for the ZeRO/TP sharding rules — encoders shard exactly
+    like decoders (heads/mlp → tp, vocab rows → tp, layers → scan).
+
+    Pass ``params`` to prune optional heads (pooler/mlm) the converted
+    model does not carry (BertForMaskedLM has no pooler; bare BertModel no
+    MLM head)."""
+    ln = {"scale": ("layers", "embed"), "bias": ("layers", "embed")}
+    axes = {
+        "embed": {"tokens": ("vocab", "embed"), "position": ("seq", "embed"),
+                  "token_type": (None, "embed")},
+        "embed_norm": {"scale": ("embed",), "bias": ("embed",)},
+        "layers": {
+            "attn": {
+                "wq": ("layers", "embed", "heads"), "bq": ("layers", "heads"),
+                "wk": ("layers", "embed", "heads"), "bk": ("layers", "heads"),
+                "wv": ("layers", "embed", "heads"), "bv": ("layers", "heads"),
+                "wo": ("layers", "heads", "embed"), "bo": ("layers", "embed"),
+            },
+            "ln_attn": dict(ln),
+            "mlp": {
+                "w_in": ("layers", "embed", "mlp"), "b_in": ("layers", "mlp"),
+                "w_out": ("layers", "mlp", "embed"),
+                "b_out": ("layers", "embed"),
+            },
+            "ln_mlp": dict(ln),
+        },
+        "mlm": {"w": ("embed", "embed"), "b": ("embed",),
+                "norm": {"scale": ("embed",), "bias": ("embed",)},
+                "decoder_bias": ("vocab",)},
+        "pooler": {"w": ("embed", "embed"), "b": ("embed",)},
+    }
+    if params is not None:
+        axes = {k: v for k, v in axes.items() if k in params}
+    return axes
+
+
+def _ln(x, scale, bias, eps):
+    """Thin adapter onto the decoder stack's layernorm (one numerics home)."""
+    from .transformer import _norm
+
+    return _norm(x, {"scale": scale, "bias": bias}, "layernorm", eps)
+
+
+def _act(x, kind):
+    from .transformer import apply_activation
+
+    return apply_activation(x, kind)
+
+
+def encode(params: Dict[str, Any], input_ids: jax.Array,
+           cfg: EncoderConfig,
+           attention_mask: Optional[jax.Array] = None,
+           token_type_ids: Optional[jax.Array] = None) -> jax.Array:
+    """input_ids (B, S) → final hidden states (B, S, H).
+
+    ``attention_mask`` (B, S): 1 = attend, 0 = padding (HF convention);
+    padded KEYS are masked for every query — bidirectional otherwise.
+    """
+    dt = jnp.dtype(cfg.dtype)
+    B, S = input_ids.shape
+    h = cfg.hidden_size
+    nh, hd = cfg.num_heads, cfg.head_dim
+    eps = cfg.norm_eps
+
+    x = params["embed"]["tokens"].astype(dt)[input_ids]
+    x = x + params["embed"]["position"].astype(dt)[None, :S]
+    tt = (token_type_ids if token_type_ids is not None
+          else jnp.zeros_like(input_ids))
+    x = x + params["embed"]["token_type"].astype(dt)[tt]
+    x = _ln(x, params["embed_norm"]["scale"], params["embed_norm"]["bias"], eps)
+
+    # (B, 1, 1, S) additive key mask, broadcasting over heads and queries
+    if attention_mask is not None:
+        key_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e30)
+    else:
+        key_bias = None
+
+    def layer_body(carry, lp):
+        x = carry
+        a = lp["attn"]
+        q = (x @ a["wq"].astype(dt) + a["bq"].astype(dt)).reshape(B, S, nh, hd)
+        k = (x @ a["wk"].astype(dt) + a["bk"].astype(dt)).reshape(B, S, nh, hd)
+        v = (x @ a["wv"].astype(dt) + a["bv"].astype(dt)).reshape(B, S, nh, hd)
+        logits = jnp.einsum("bshd,bthd->bhst", q, k) / math.sqrt(hd)
+        logits = logits.astype(jnp.float32)
+        if key_bias is not None:
+            logits = logits + key_bias
+        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+        o = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, h)
+        o = o @ a["wo"].astype(dt) + a["bo"].astype(dt)
+        x = _ln(x + o, lp["ln_attn"]["scale"], lp["ln_attn"]["bias"], eps)
+        m = _act(x @ lp["mlp"]["w_in"].astype(dt)
+                 + lp["mlp"]["b_in"].astype(dt), cfg.activation)
+        m = m @ lp["mlp"]["w_out"].astype(dt) + lp["mlp"]["b_out"].astype(dt)
+        x = _ln(x + m, lp["ln_mlp"]["scale"], lp["ln_mlp"]["bias"], eps)
+        return x, None
+
+    from .transformer import _remat_policy
+
+    body = layer_body
+    pol = _remat_policy(cfg.remat_policy)
+    if cfg.remat_policy != "everything":
+        body = jax.checkpoint(layer_body, policy=pol)
+    x, _ = lax.scan(body, x, params["layers"])
+    return x
+
+
+def mlm_logits(params: Dict[str, Any], input_ids: jax.Array,
+               cfg: EncoderConfig,
+               attention_mask: Optional[jax.Array] = None,
+               token_type_ids: Optional[jax.Array] = None) -> jax.Array:
+    """BertForMaskedLM head: dense → GELU → LN → tied decoder + bias."""
+    dt = jnp.dtype(cfg.dtype)
+    x = encode(params, input_ids, cfg, attention_mask, token_type_ids)
+    m = params["mlm"]
+    x = _act(x @ m["w"].astype(dt) + m["b"].astype(dt), cfg.activation)
+    x = _ln(x, m["norm"]["scale"], m["norm"]["bias"], cfg.norm_eps)
+    return x @ params["embed"]["tokens"].astype(dt).T + \
+        m["decoder_bias"].astype(dt)
+
+
+def pooled_output(params: Dict[str, Any], input_ids: jax.Array,
+                  cfg: EncoderConfig,
+                  attention_mask: Optional[jax.Array] = None,
+                  token_type_ids: Optional[jax.Array] = None) -> jax.Array:
+    """[CLS] tanh pooler (sequence-classification input)."""
+    dt = jnp.dtype(cfg.dtype)
+    x = encode(params, input_ids, cfg, attention_mask, token_type_ids)
+    p = params["pooler"]
+    return jnp.tanh(x[:, 0] @ p["w"].astype(dt) + p["b"].astype(dt))
+
+
+def mlm_loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+                cfg: EncoderConfig):
+    """Masked-LM cross entropy.  batch: {'input_ids', 'labels'} with -100 on
+    unmasked positions (HF convention); optional 'attention_mask',
+    'token_type_ids'."""
+    logits = mlm_logits(params, batch["input_ids"], cfg,
+                        batch.get("attention_mask"),
+                        batch.get("token_type_ids"))
+    labels = batch["labels"]
+    mask = (labels != -100).astype(jnp.float32)
+    safe = jnp.where(labels == -100, 0, labels)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    loss = jnp.sum(nll * mask) / denom
+    return loss, {"loss": loss, "accuracy": jnp.sum(
+        (jnp.argmax(logits, -1) == labels) * mask) / denom,
+        "tokens": denom}

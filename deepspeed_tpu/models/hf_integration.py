@@ -77,6 +77,19 @@ def config_from_hf(hf_config) -> tfm.TransformerConfig:
             norm="layernorm", activation="gelu_exact",
             norm_eps=get("layer_norm_epsilon", 1e-5),
             tie_embeddings=bool(get("tie_word_embeddings", True)))
+    if model_type == "bloom":
+        if get("apply_residual_connection_post_layernorm", False):
+            raise ValueError(
+                "bloom apply_residual_connection_post_layernorm=True "
+                "(bloom-176b-intermediate variants) is not supported")
+        h = get("hidden_size") or get("n_embed")
+        return tfm.TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=h,
+            intermediate_size=4 * h, num_layers=get("n_layer"),
+            num_heads=get("n_head"), max_seq_len=get("seq_length", 2048),
+            norm="layernorm", activation="gelu", position="alibi",
+            embed_norm=True, norm_eps=get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=bool(get("tie_word_embeddings", True)))
     if model_type == "opt":
         h = get("hidden_size")
         if get("word_embed_proj_dim", h) != h:
@@ -170,6 +183,11 @@ def _rope_unpermute_bias(b: np.ndarray, n_heads: int, head_dim: int,
                          rot_dim: Optional[int] = None) -> np.ndarray:
     """Bias rows are permuted exactly like weight output rows."""
     return _rope_unpermute(b[None], n_heads, head_dim, rot_dim)[0]
+
+
+def _rope_permute_bias(b: np.ndarray, n_heads: int, head_dim: int,
+                       rot_dim: Optional[int] = None) -> np.ndarray:
+    return _rope_permute(b[None], n_heads, head_dim, rot_dim)[0]
 
 
 # shared per-layer stacking helpers (every converter maps "pattern with layer
@@ -564,6 +582,362 @@ def params_to_hf_llama(params: Dict[str, Any], cfg: tfm.TransformerConfig
     return out
 
 
+def params_from_hf_bloom(state_dict: Dict[str, Any],
+                         cfg: tfm.TransformerConfig) -> Dict[str, Any]:
+    """BLOOM: ALiBi positions (no rotary permutation), embedding layernorm,
+    per-head-fused [q,k,v] query_key_value (same head-major layout as
+    gpt-neox), GELU MLP, biases throughout.  Reference policy:
+    ``module_inject/containers/bloom.py:105``."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, hd, nh = cfg.num_layers, cfg.head_dim, cfg.num_heads
+
+    def split_qkv(i):
+        w = sd[f"h.{i}.self_attention.query_key_value.weight"]  # (3h, h)
+        b = sd[f"h.{i}.self_attention.query_key_value.bias"]
+        wg = w.reshape(nh, 3, hd, -1)
+        bg = b.reshape(nh, 3, hd)
+        return [(wg[:, j].reshape(nh * hd, -1).T, bg[:, j].reshape(nh * hd))
+                for j in range(3)]
+
+    per_layer = [split_qkv(i) for i in range(L)]
+    lb = lambda pattern: _lnorm(sd, pattern, L)  # noqa: E731
+
+    return {
+        "embed": {"tokens": sd["word_embeddings.weight"]},
+        "embed_norm": {"scale": sd["word_embeddings_layernorm.weight"],
+                       "bias": sd["word_embeddings_layernorm.bias"]},
+        "layers": {
+            "attn": {
+                "wq": _stack([pl[0][0] for pl in per_layer]),
+                "wk": _stack([pl[1][0] for pl in per_layer]),
+                "wv": _stack([pl[2][0] for pl in per_layer]),
+                "wo": _lw(sd, "h.{}.self_attention.dense.weight", L),
+                "bq": _stack([pl[0][1] for pl in per_layer]),
+                "bk": _stack([pl[1][1] for pl in per_layer]),
+                "bv": _stack([pl[2][1] for pl in per_layer]),
+                "bo": lb("h.{}.self_attention.dense.bias"),
+            },
+            "ln1": {"scale": lb("h.{}.input_layernorm.weight"),
+                    "bias": lb("h.{}.input_layernorm.bias")},
+            "ln2": {"scale": lb("h.{}.post_attention_layernorm.weight"),
+                    "bias": lb("h.{}.post_attention_layernorm.bias")},
+            "mlp": {
+                "w_in": _lw(sd, "h.{}.mlp.dense_h_to_4h.weight", L),
+                "w_out": _lw(sd, "h.{}.mlp.dense_4h_to_h.weight", L),
+                "b_in": lb("h.{}.mlp.dense_h_to_4h.bias"),
+                "b_out": lb("h.{}.mlp.dense_4h_to_h.bias"),
+            },
+        },
+        "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+
+
+def params_to_hf_bloom(params: Dict[str, Any], cfg: tfm.TransformerConfig
+                       ) -> Dict[str, np.ndarray]:
+    """BLOOM export: re-fuse the per-head [q,k,v] query_key_value."""
+    lp = params["layers"]
+    nh, hd, h = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    out: Dict[str, np.ndarray] = {
+        "transformer.word_embeddings.weight": np.asarray(
+            params["embed"]["tokens"]),
+        "transformer.word_embeddings_layernorm.weight": np.asarray(
+            params["embed_norm"]["scale"]),
+        "transformer.word_embeddings_layernorm.bias": np.asarray(
+            params["embed_norm"]["bias"]),
+        "transformer.ln_f.weight": np.asarray(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": np.asarray(params["final_norm"]["bias"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}"
+        ws = [np.asarray(lp["attn"][n][i]).T.reshape(nh, hd, h)
+              for n in ("wq", "wk", "wv")]
+        bs = [np.asarray(lp["attn"][n][i]).reshape(nh, hd)
+              for n in ("bq", "bk", "bv")]
+        out[f"{pre}.self_attention.query_key_value.weight"] = \
+            np.stack(ws, axis=1).reshape(3 * nh * hd, h)
+        out[f"{pre}.self_attention.query_key_value.bias"] = \
+            np.stack(bs, axis=1).reshape(3 * nh * hd)
+        out[f"{pre}.self_attention.dense.weight"] = \
+            np.asarray(lp["attn"]["wo"][i]).T
+        out[f"{pre}.self_attention.dense.bias"] = \
+            np.asarray(lp["attn"]["bo"][i])
+        out[f"{pre}.input_layernorm.weight"] = np.asarray(lp["ln1"]["scale"][i])
+        out[f"{pre}.input_layernorm.bias"] = np.asarray(lp["ln1"]["bias"][i])
+        out[f"{pre}.post_attention_layernorm.weight"] = \
+            np.asarray(lp["ln2"]["scale"][i])
+        out[f"{pre}.post_attention_layernorm.bias"] = \
+            np.asarray(lp["ln2"]["bias"][i])
+        out[f"{pre}.mlp.dense_h_to_4h.weight"] = \
+            np.asarray(lp["mlp"]["w_in"][i]).T
+        out[f"{pre}.mlp.dense_h_to_4h.bias"] = np.asarray(lp["mlp"]["b_in"][i])
+        out[f"{pre}.mlp.dense_4h_to_h.weight"] = \
+            np.asarray(lp["mlp"]["w_out"][i]).T
+        out[f"{pre}.mlp.dense_4h_to_h.bias"] = np.asarray(lp["mlp"]["b_out"][i])
+    if not cfg.tie_embeddings and "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]["w"]).T
+    return out
+
+
+def params_to_hf_qwen2(params: Dict[str, Any], cfg: tfm.TransformerConfig
+                       ) -> Dict[str, np.ndarray]:
+    """Qwen2 export: llama schema + rotate_half-permuted q/k/v biases."""
+    out = params_to_hf_llama(params, cfg)
+    attn = params["layers"]["attn"]
+    if "bq" in attn:
+        for i in range(cfg.num_layers):
+            pre = f"model.layers.{i}.self_attn"
+            out[f"{pre}.q_proj.bias"] = _rope_permute_bias(
+                np.asarray(attn["bq"][i]), cfg.num_heads, cfg.head_dim)
+            out[f"{pre}.k_proj.bias"] = _rope_permute_bias(
+                np.asarray(attn["bk"][i]), cfg.kv_heads, cfg.head_dim)
+            out[f"{pre}.v_proj.bias"] = np.asarray(attn["bv"][i])
+    return out
+
+
+def params_to_hf_gpt2(params: Dict[str, Any], cfg: tfm.TransformerConfig
+                      ) -> Dict[str, np.ndarray]:
+    """GPT-2 export (Conv1D layout: (in, out), fused c_attn).  Keys carry
+    the ``transformer.`` prefix of the HF LMHead checkpoint; the tied
+    lm_head is omitted as HF does for tied weights."""
+    lp = params["layers"]
+    out: Dict[str, np.ndarray] = {
+        "transformer.wte.weight": np.asarray(params["embed"]["tokens"]),
+        "transformer.wpe.weight": np.asarray(params["embed"]["position"]),
+        "transformer.ln_f.weight": np.asarray(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": np.asarray(params["final_norm"]["bias"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}"
+        a = lp["attn"]
+        out[f"{pre}.attn.c_attn.weight"] = np.concatenate(
+            [np.asarray(a["wq"][i]), np.asarray(a["wk"][i]),
+             np.asarray(a["wv"][i])], axis=1)
+        out[f"{pre}.attn.c_attn.bias"] = np.concatenate(
+            [np.asarray(a["bq"][i]), np.asarray(a["bk"][i]),
+             np.asarray(a["bv"][i])])
+        out[f"{pre}.attn.c_proj.weight"] = np.asarray(a["wo"][i])
+        out[f"{pre}.attn.c_proj.bias"] = np.asarray(a["bo"][i])
+        out[f"{pre}.ln_1.weight"] = np.asarray(lp["ln1"]["scale"][i])
+        out[f"{pre}.ln_1.bias"] = np.asarray(lp["ln1"]["bias"][i])
+        out[f"{pre}.ln_2.weight"] = np.asarray(lp["ln2"]["scale"][i])
+        out[f"{pre}.ln_2.bias"] = np.asarray(lp["ln2"]["bias"][i])
+        out[f"{pre}.mlp.c_fc.weight"] = np.asarray(lp["mlp"]["w_in"][i])
+        out[f"{pre}.mlp.c_fc.bias"] = np.asarray(lp["mlp"]["b_in"][i])
+        out[f"{pre}.mlp.c_proj.weight"] = np.asarray(lp["mlp"]["w_out"][i])
+        out[f"{pre}.mlp.c_proj.bias"] = np.asarray(lp["mlp"]["b_out"][i])
+    return out
+
+
+def params_to_hf_mixtral(params: Dict[str, Any], cfg: tfm.TransformerConfig
+                         ) -> Dict[str, np.ndarray]:
+    """Mixtral export: llama attention + per-expert w1/w2/w3 + router gate."""
+    lp = params["layers"]
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["tokens"]),
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
+    }
+    moe = lp["moe"]
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}"
+        out[f"{pre}.self_attn.q_proj.weight"] = _rope_permute(
+            np.asarray(lp["attn"]["wq"][i]), cfg.num_heads, cfg.head_dim).T
+        out[f"{pre}.self_attn.k_proj.weight"] = _rope_permute(
+            np.asarray(lp["attn"]["wk"][i]), cfg.kv_heads, cfg.head_dim).T
+        out[f"{pre}.self_attn.v_proj.weight"] = np.asarray(lp["attn"]["wv"][i]).T
+        out[f"{pre}.self_attn.o_proj.weight"] = np.asarray(lp["attn"]["wo"][i]).T
+        out[f"{pre}.input_layernorm.weight"] = np.asarray(lp["ln1"]["scale"][i])
+        out[f"{pre}.post_attention_layernorm.weight"] = \
+            np.asarray(lp["ln2"]["scale"][i])
+        out[f"{pre}.block_sparse_moe.gate.weight"] = \
+            np.asarray(moe["router"][i]).T
+        for e in range(cfg.num_experts):
+            epre = f"{pre}.block_sparse_moe.experts.{e}"
+            out[f"{epre}.w1.weight"] = np.asarray(moe["w_gate"][i, e]).T
+            out[f"{epre}.w2.weight"] = np.asarray(moe["w_out"][i, e]).T
+            out[f"{epre}.w3.weight"] = np.asarray(moe["w_in"][i, e]).T
+    if not cfg.tie_embeddings and "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]["w"]).T
+    return out
+
+
+def params_to_hf_phi3(params: Dict[str, Any], cfg: tfm.TransformerConfig
+                      ) -> Dict[str, np.ndarray]:
+    """Phi-3 export: re-fuse qkv_proj and gate_up_proj."""
+    lp = params["layers"]
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["tokens"]),
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}"
+        q = _rope_permute(np.asarray(lp["attn"]["wq"][i]),
+                          cfg.num_heads, cfg.head_dim).T
+        k = _rope_permute(np.asarray(lp["attn"]["wk"][i]),
+                          cfg.kv_heads, cfg.head_dim).T
+        v = np.asarray(lp["attn"]["wv"][i]).T
+        out[f"{pre}.self_attn.qkv_proj.weight"] = np.concatenate([q, k, v])
+        out[f"{pre}.self_attn.o_proj.weight"] = np.asarray(lp["attn"]["wo"][i]).T
+        out[f"{pre}.mlp.gate_up_proj.weight"] = np.concatenate(
+            [np.asarray(lp["mlp"]["w_gate"][i]).T,
+             np.asarray(lp["mlp"]["w_in"][i]).T])
+        out[f"{pre}.mlp.down_proj.weight"] = np.asarray(lp["mlp"]["w_out"][i]).T
+        out[f"{pre}.input_layernorm.weight"] = np.asarray(lp["ln1"]["scale"][i])
+        out[f"{pre}.post_attention_layernorm.weight"] = \
+            np.asarray(lp["ln2"]["scale"][i])
+    if not cfg.tie_embeddings and "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]["w"]).T
+    return out
+
+
+def params_to_hf_falcon(params: Dict[str, Any], cfg: tfm.TransformerConfig,
+                        hf_config=None) -> Dict[str, np.ndarray]:
+    """Falcon export: re-fuse query_key_value in the generation's layout.
+    Models with ONE shared layernorm read it from ``ln1`` (the import
+    duplicated it; if training diverged ln1/ln2, the shared-LN architecture
+    cannot represent both — ln1 wins)."""
+    get = _getter(hf_config) if hf_config is not None else (lambda k, d=None: d)
+    lp = params["layers"]
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    h = cfg.hidden_size
+    out: Dict[str, np.ndarray] = {
+        "transformer.word_embeddings.weight": np.asarray(
+            params["embed"]["tokens"]),
+        "transformer.ln_f.weight": np.asarray(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": np.asarray(params["final_norm"]["bias"]),
+    }
+    # layout detection mirrors the import: dual ln_attn/ln_mlp on
+    # new-architecture models (falcon-40b/180b style)
+    dual_ln = bool(get("new_decoder_architecture", False)) and \
+        (get("num_ln_in_parallel_attn") or 2) == 2
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}"
+        q = _rope_permute(np.asarray(lp["attn"]["wq"][i]), nh, hd).T
+        k = _rope_permute(np.asarray(lp["attn"]["wk"][i]), nkv, hd).T
+        v = np.asarray(lp["attn"]["wv"][i]).T
+        if get("new_decoder_architecture", False):
+            g = nh // nkv
+            wg = np.empty((nkv, g + 2, hd, h), q.dtype)
+            wg[:, :g] = q.reshape(nkv, g, hd, h)
+            wg[:, g] = k.reshape(nkv, hd, h)
+            wg[:, g + 1] = v.reshape(nkv, hd, h)
+            qkv = wg.reshape((g + 2) * nkv * hd, h)
+        elif get("multi_query", True):
+            qkv = np.concatenate([q, k, v])
+        else:
+            wg = np.stack([q.reshape(nh, hd, h), k.reshape(nh, hd, h),
+                           v.reshape(nh, hd, h)], axis=1)
+            qkv = wg.reshape(3 * nh * hd, h)
+        out[f"{pre}.self_attention.query_key_value.weight"] = qkv
+        out[f"{pre}.self_attention.dense.weight"] = \
+            np.asarray(lp["attn"]["wo"][i]).T
+        if dual_ln:
+            out[f"{pre}.ln_attn.weight"] = np.asarray(lp["ln1"]["scale"][i])
+            out[f"{pre}.ln_attn.bias"] = np.asarray(lp["ln1"]["bias"][i])
+            out[f"{pre}.ln_mlp.weight"] = np.asarray(lp["ln2"]["scale"][i])
+            out[f"{pre}.ln_mlp.bias"] = np.asarray(lp["ln2"]["bias"][i])
+        else:
+            out[f"{pre}.input_layernorm.weight"] = \
+                np.asarray(lp["ln1"]["scale"][i])
+            out[f"{pre}.input_layernorm.bias"] = \
+                np.asarray(lp["ln1"]["bias"][i])
+        out[f"{pre}.mlp.dense_h_to_4h.weight"] = \
+            np.asarray(lp["mlp"]["w_in"][i]).T
+        out[f"{pre}.mlp.dense_4h_to_h.weight"] = \
+            np.asarray(lp["mlp"]["w_out"][i]).T
+    if not cfg.tie_embeddings and "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]["w"]).T
+    return out
+
+
+def params_to_hf_gpt_neox(params: Dict[str, Any], cfg: tfm.TransformerConfig
+                          ) -> Dict[str, np.ndarray]:
+    """GPT-NeoX export: re-fuse the per-head [q,k,v] query_key_value."""
+    lp = params["layers"]
+    nh, hd, h, rot = cfg.num_heads, cfg.head_dim, cfg.hidden_size, cfg.rot_dim
+    out: Dict[str, np.ndarray] = {
+        "gpt_neox.embed_in.weight": np.asarray(params["embed"]["tokens"]),
+        "gpt_neox.final_layer_norm.weight": np.asarray(
+            params["final_norm"]["scale"]),
+        "gpt_neox.final_layer_norm.bias": np.asarray(
+            params["final_norm"]["bias"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"gpt_neox.layers.{i}"
+        ws, bs = [], []
+        for name, bname, rotate in (("wq", "bq", True), ("wk", "bk", True),
+                                    ("wv", "bv", False)):
+            w = np.asarray(lp["attn"][name][i])
+            b = np.asarray(lp["attn"][bname][i])
+            if rotate:
+                w = _rope_permute(w, nh, hd, rot)
+                b = _rope_permute_bias(b, nh, hd, rot)
+            ws.append(w.T.reshape(nh, hd, h))
+            bs.append(b.reshape(nh, hd))
+        out[f"{pre}.attention.query_key_value.weight"] = \
+            np.stack(ws, axis=1).reshape(3 * nh * hd, h)
+        out[f"{pre}.attention.query_key_value.bias"] = \
+            np.stack(bs, axis=1).reshape(3 * nh * hd)
+        out[f"{pre}.attention.dense.weight"] = np.asarray(lp["attn"]["wo"][i]).T
+        out[f"{pre}.attention.dense.bias"] = np.asarray(lp["attn"]["bo"][i])
+        out[f"{pre}.input_layernorm.weight"] = np.asarray(lp["ln1"]["scale"][i])
+        out[f"{pre}.input_layernorm.bias"] = np.asarray(lp["ln1"]["bias"][i])
+        out[f"{pre}.post_attention_layernorm.weight"] = \
+            np.asarray(lp["ln2"]["scale"][i])
+        out[f"{pre}.post_attention_layernorm.bias"] = \
+            np.asarray(lp["ln2"]["bias"][i])
+        out[f"{pre}.mlp.dense_h_to_4h.weight"] = \
+            np.asarray(lp["mlp"]["w_in"][i]).T
+        out[f"{pre}.mlp.dense_h_to_4h.bias"] = np.asarray(lp["mlp"]["b_in"][i])
+        out[f"{pre}.mlp.dense_4h_to_h.weight"] = \
+            np.asarray(lp["mlp"]["w_out"][i]).T
+        out[f"{pre}.mlp.dense_4h_to_h.bias"] = np.asarray(lp["mlp"]["b_out"][i])
+    if not cfg.tie_embeddings and "lm_head" in params:
+        out["embed_out.weight"] = np.asarray(params["lm_head"]["w"]).T
+    return out
+
+
+def params_to_hf_opt(params: Dict[str, Any], cfg: tfm.TransformerConfig
+                     ) -> Dict[str, np.ndarray]:
+    """OPT export.  The HF positional table's first two rows (the padding
+    offset OPTLearnedPositionalEmbedding never reads for causal LM inputs)
+    are reconstructed as zeros."""
+    lp = params["layers"]
+    pos = np.asarray(params["embed"]["position"])
+    out: Dict[str, np.ndarray] = {
+        "model.decoder.embed_tokens.weight": np.asarray(
+            params["embed"]["tokens"]),
+        "model.decoder.embed_positions.weight": np.concatenate(
+            [np.zeros((2,) + pos.shape[1:], pos.dtype), pos]),
+        "model.decoder.final_layer_norm.weight": np.asarray(
+            params["final_norm"]["scale"]),
+        "model.decoder.final_layer_norm.bias": np.asarray(
+            params["final_norm"]["bias"]),
+    }
+    names = (("self_attn.q_proj", "wq", "bq"),
+             ("self_attn.k_proj", "wk", "bk"),
+             ("self_attn.v_proj", "wv", "bv"),
+             ("self_attn.out_proj", "wo", "bo"))
+    for i in range(cfg.num_layers):
+        pre = f"model.decoder.layers.{i}"
+        for hf_name, wkey, bkey in names:
+            out[f"{pre}.{hf_name}.weight"] = np.asarray(lp["attn"][wkey][i]).T
+            out[f"{pre}.{hf_name}.bias"] = np.asarray(lp["attn"][bkey][i])
+        out[f"{pre}.self_attn_layer_norm.weight"] = \
+            np.asarray(lp["ln1"]["scale"][i])
+        out[f"{pre}.self_attn_layer_norm.bias"] = \
+            np.asarray(lp["ln1"]["bias"][i])
+        out[f"{pre}.final_layer_norm.weight"] = \
+            np.asarray(lp["ln2"]["scale"][i])
+        out[f"{pre}.final_layer_norm.bias"] = np.asarray(lp["ln2"]["bias"][i])
+        out[f"{pre}.fc1.weight"] = np.asarray(lp["mlp"]["w_in"][i]).T
+        out[f"{pre}.fc1.bias"] = np.asarray(lp["mlp"]["b_in"][i])
+        out[f"{pre}.fc2.weight"] = np.asarray(lp["mlp"]["w_out"][i]).T
+        out[f"{pre}.fc2.bias"] = np.asarray(lp["mlp"]["b_out"][i])
+    if not cfg.tie_embeddings and "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]["w"]).T
+    return out
+
+
 # model_type → converter.  The registry the reference keeps as
 # ``module_inject/containers/`` policies + ``replace_module.py`` policy_to_ds
 # dispatch; new architectures register here.
@@ -577,11 +951,181 @@ ARCH_CONVERTERS: Dict[str, Callable] = {
     "gpt_neox": params_from_hf_gpt_neox,
     "opt": params_from_hf_opt,
     "gpt2": params_from_hf_gpt2,
+    "bloom": params_from_hf_bloom,
 }
 
 
+# model_type → reverse exporter (save_16bit_model / zero_to_fp32 role):
+# every importable family exports back to its HF state-dict schema.
+ARCH_EXPORTERS: Dict[str, Callable] = {
+    "llama": params_to_hf_llama,
+    "mistral": params_to_hf_llama,
+    "qwen2": params_to_hf_qwen2,
+    "mixtral": params_to_hf_mixtral,
+    "phi3": params_to_hf_phi3,
+    "falcon": params_to_hf_falcon,
+    "gpt_neox": params_to_hf_gpt_neox,
+    "opt": params_to_hf_opt,
+    "gpt2": params_to_hf_gpt2,
+    "bloom": params_to_hf_bloom,
+}
+
+
+def params_to_hf(params: Dict[str, Any], cfg: tfm.TransformerConfig,
+                 model_type: str = "llama", hf_config=None
+                 ) -> Dict[str, np.ndarray]:
+    """Export a trained param pytree back to the HF state dict of
+    ``model_type`` (reference: ``zero_to_fp32``/``save_16bit_model`` — the
+    consolidated export the HF ecosystem reloads)."""
+    if model_type == "bert":
+        return params_to_hf_bert(params, cfg)
+    export = ARCH_EXPORTERS.get(model_type)
+    if export is None:
+        raise ValueError(
+            f"no HF exporter for model_type {model_type!r}; supported: "
+            f"{tuple(sorted(ARCH_EXPORTERS))}")
+    if export is params_to_hf_falcon:
+        return export(params, cfg, hf_config)
+    return export(params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# encoder family (BERT) — reference: module_inject/containers/bert.py:30
+# ---------------------------------------------------------------------------
+
+
+def encoder_config_from_hf(hf_config) -> "Any":
+    from .encoder import EncoderConfig
+
+    get = _getter(hf_config)
+    act = str(get("hidden_act", "gelu"))
+    return EncoderConfig(
+        vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        max_seq_len=get("max_position_embeddings", 512),
+        type_vocab_size=get("type_vocab_size", 2),
+        norm_eps=get("layer_norm_eps", 1e-12),
+        # HF bert 'gelu' is the erf form; 'gelu_new' the tanh approximation
+        activation="gelu" if act == "gelu_new" else "gelu_exact")
+
+
+def params_from_hf_bert(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """BertModel/BertForMaskedLM state dict → encoder param pytree.  The
+    ``bert.`` prefix is accepted with or without; the pooler and MLM head
+    convert when present."""
+    sd = {k.removeprefix("bert."): np.asarray(v)
+          for k, v in state_dict.items()}
+    L = cfg.num_layers
+    pre = "encoder.layer.{}"
+
+    def lw(name):
+        return _stack([sd[(pre + "." + name + ".weight").format(i)].T
+                       for i in range(L)])
+
+    def lb(name, field="bias"):
+        return _stack([sd[(pre + "." + name + "." + field).format(i)]
+                       for i in range(L)])
+
+    params: Dict[str, Any] = {
+        "embed": {
+            "tokens": sd["embeddings.word_embeddings.weight"],
+            "position": sd["embeddings.position_embeddings.weight"],
+            "token_type": sd["embeddings.token_type_embeddings.weight"],
+        },
+        "embed_norm": {"scale": sd["embeddings.LayerNorm.weight"],
+                       "bias": sd["embeddings.LayerNorm.bias"]},
+        "layers": {
+            "attn": {
+                "wq": lw("attention.self.query"),
+                "bq": lb("attention.self.query"),
+                "wk": lw("attention.self.key"),
+                "bk": lb("attention.self.key"),
+                "wv": lw("attention.self.value"),
+                "bv": lb("attention.self.value"),
+                "wo": lw("attention.output.dense"),
+                "bo": lb("attention.output.dense"),
+            },
+            "ln_attn": {"scale": lb("attention.output.LayerNorm", "weight"),
+                        "bias": lb("attention.output.LayerNorm")},
+            "mlp": {
+                "w_in": lw("intermediate.dense"),
+                "b_in": lb("intermediate.dense"),
+                "w_out": lw("output.dense"),
+                "b_out": lb("output.dense"),
+            },
+            "ln_mlp": {"scale": lb("output.LayerNorm", "weight"),
+                       "bias": lb("output.LayerNorm")},
+        },
+    }
+    if "pooler.dense.weight" in sd:
+        params["pooler"] = {"w": sd["pooler.dense.weight"].T,
+                            "b": sd["pooler.dense.bias"]}
+    if "cls.predictions.transform.dense.weight" in sd:
+        params["mlm"] = {
+            "w": sd["cls.predictions.transform.dense.weight"].T,
+            "b": sd["cls.predictions.transform.dense.bias"],
+            "norm": {"scale": sd["cls.predictions.transform.LayerNorm.weight"],
+                     "bias": sd["cls.predictions.transform.LayerNorm.bias"]},
+            "decoder_bias": sd.get("cls.predictions.bias",
+                                   sd.get("cls.predictions.decoder.bias")),
+        }
+    return params
+
+
+def params_to_hf_bert(params: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    """Encoder export back to the BertForMaskedLM state-dict schema."""
+    out: Dict[str, np.ndarray] = {
+        "bert.embeddings.word_embeddings.weight": np.asarray(
+            params["embed"]["tokens"]),
+        "bert.embeddings.position_embeddings.weight": np.asarray(
+            params["embed"]["position"]),
+        "bert.embeddings.token_type_embeddings.weight": np.asarray(
+            params["embed"]["token_type"]),
+        "bert.embeddings.LayerNorm.weight": np.asarray(
+            params["embed_norm"]["scale"]),
+        "bert.embeddings.LayerNorm.bias": np.asarray(
+            params["embed_norm"]["bias"]),
+    }
+    lp = params["layers"]
+    pairs = (("attention.self.query", "attn", "wq", "bq"),
+             ("attention.self.key", "attn", "wk", "bk"),
+             ("attention.self.value", "attn", "wv", "bv"),
+             ("attention.output.dense", "attn", "wo", "bo"),
+             ("intermediate.dense", "mlp", "w_in", "b_in"),
+             ("output.dense", "mlp", "w_out", "b_out"))
+    for i in range(cfg.num_layers):
+        pre = f"bert.encoder.layer.{i}"
+        for hf_name, blk, wk, bk in pairs:
+            out[f"{pre}.{hf_name}.weight"] = np.asarray(lp[blk][wk][i]).T
+            out[f"{pre}.{hf_name}.bias"] = np.asarray(lp[blk][bk][i])
+        out[f"{pre}.attention.output.LayerNorm.weight"] = \
+            np.asarray(lp["ln_attn"]["scale"][i])
+        out[f"{pre}.attention.output.LayerNorm.bias"] = \
+            np.asarray(lp["ln_attn"]["bias"][i])
+        out[f"{pre}.output.LayerNorm.weight"] = \
+            np.asarray(lp["ln_mlp"]["scale"][i])
+        out[f"{pre}.output.LayerNorm.bias"] = \
+            np.asarray(lp["ln_mlp"]["bias"][i])
+    if "pooler" in params:
+        out["bert.pooler.dense.weight"] = np.asarray(params["pooler"]["w"]).T
+        out["bert.pooler.dense.bias"] = np.asarray(params["pooler"]["b"])
+    if "mlm" in params:
+        out["cls.predictions.transform.dense.weight"] = \
+            np.asarray(params["mlm"]["w"]).T
+        out["cls.predictions.transform.dense.bias"] = \
+            np.asarray(params["mlm"]["b"])
+        out["cls.predictions.transform.LayerNorm.weight"] = \
+            np.asarray(params["mlm"]["norm"]["scale"])
+        out["cls.predictions.transform.LayerNorm.bias"] = \
+            np.asarray(params["mlm"]["norm"]["bias"])
+        out["cls.predictions.bias"] = np.asarray(params["mlm"]["decoder_bias"])
+    return out
+
+
 def supported_architectures() -> tuple:
-    return tuple(sorted(ARCH_CONVERTERS))
+    return tuple(sorted(ARCH_CONVERTERS)) + ("bert",)
 
 
 def load_hf_model(model_name_or_sd, hf_config=None,
@@ -597,8 +1141,11 @@ def load_hf_model(model_name_or_sd, hf_config=None,
             sd = {k.removeprefix("transformer."): v for k, v in sd.items()}
     else:
         sd = model_name_or_sd
-    cfg = config_from_hf(hf_config)
     model_type = _getter(hf_config)("model_type", "llama")
+    if model_type == "bert":  # encoder family: its own config + schema
+        ecfg = encoder_config_from_hf(hf_config)
+        return ecfg, params_from_hf_bert(sd, ecfg)
+    cfg = config_from_hf(hf_config)
     convert = ARCH_CONVERTERS.get(model_type)
     if convert is None:
         raise ValueError(

@@ -43,8 +43,11 @@ class TransformerConfig:
     # architecture switches
     norm: str = "rmsnorm"  # rmsnorm (llama) | layernorm (gpt2)
     activation: str = "silu"  # silu => SwiGLU; gelu => GELU MLP; relu (opt)
-    position: str = "rope"  # rope (llama) | learned (gpt2)
+    position: str = "rope"  # rope (llama) | learned (gpt2) | alibi (bloom)
     tie_embeddings: bool = True
+    # LayerNorm right after the embedding lookup (bloom
+    # word_embeddings_layernorm)
+    embed_norm: bool = False
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     # parallel attention+MLP residual (falcon/gpt-neox/phi-2):
@@ -215,6 +218,9 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
         params["final_norm"]["bias"] = jnp.zeros((h,), pd)
     if cfg.position == "learned":
         params["embed"]["position"] = _dense_init(keys[9], (cfg.max_seq_len, h), h, pd)
+    if cfg.embed_norm:
+        params["embed_norm"] = {"scale": jnp.ones((h,), pd),
+                                "bias": jnp.zeros((h,), pd)}
     if not cfg.tie_embeddings:
         params["lm_head"] = {"w": _dense_init(keys[10], (h, cfg.vocab_size), h, pd)}
     return params
@@ -271,6 +277,8 @@ def param_axes(cfg: TransformerConfig, params: Optional[Dict[str, Any]] = None
     }
     if cfg.position == "learned":
         axes["embed"]["position"] = ("seq", "embed")
+    if cfg.embed_norm:
+        axes["embed_norm"] = {"scale": ("embed",), "bias": ("embed",)}
     if not cfg.tie_embeddings:
         axes["lm_head"] = {"w": ("embed", "vocab")}
 
@@ -332,9 +340,35 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([out, x[..., rot:]], axis=-1)
 
 
+def alibi_slopes(n_heads: int) -> jax.Array:
+    """Per-head ALiBi slopes (Press et al.; matches HF
+    ``build_alibi_tensor``): powers of 2^(-8/n) for the nearest power-of-two
+    head count, with interleaved extras for non-power-of-two counts."""
+    import math as _m
+
+    p2 = 2 ** _m.floor(_m.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(_m.log2(p2) - 3)))
+    slopes = [base ** (i + 1) for i in range(p2)]
+    if p2 != n_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(_m.log2(2 * p2) - 3)))
+        slopes += [extra_base ** (i + 1)
+                   for i in range(0, 2 * (n_heads - p2), 2)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def alibi_bias(n_heads: int, seq_len: int) -> jax.Array:
+    """(H, 1, S) additive attention-logit bias: slope · key-position.  Per
+    query row this differs from the relative form by a constant, which
+    softmax cancels — exactly HF bloom's formulation."""
+    return alibi_slopes(n_heads)[:, None, None] * \
+        jnp.arange(seq_len, dtype=jnp.float32)[None, None, :]
+
+
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
-                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
-    """Reference einsum attention (B, S, H, D). GQA-aware."""
+                  segment_ids: Optional[jax.Array] = None,
+                  bias: Optional[jax.Array] = None) -> jax.Array:
+    """Reference einsum attention (B, S, H, D). GQA-aware.  ``bias``
+    broadcasts onto the (B, H, S, T) logits (ALiBi, padding masks)."""
     B, S, H, D = q.shape
     KV = k.shape[2]
     if KV != H:  # grouped-query: repeat kv heads
@@ -344,6 +378,8 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
     scale = 1.0 / math.sqrt(D)
     logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if causal:
         mask = jnp.tril(jnp.ones((S, S), bool))
         logits = jnp.where(mask[None, None], logits, -1e30)
@@ -405,8 +441,27 @@ def _attention_block(x, p, cfg: TransformerConfig, cos, sin, attn_fn: AttentionF
         if cfg.position == "rope":
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
-        o = attn_fn(q, k, v, causal=True)
+        if cfg.position == "alibi":
+            # additive logit bias rides the einsum path (flash+bias belongs
+            # to the evoformer-style biased kernel; alibi models use 'xla')
+            o = attn_fn(q, k, v, causal=True,
+                        bias=alibi_bias(nh, S)[None])
+        else:
+            o = attn_fn(q, k, v, causal=True)
         return _lin(o.reshape(B, S, nh * hd), p, "wo", "bo")
+
+
+def apply_activation(x, kind: str):
+    """Shared activation dispatch (decoder MLPs, encoder blocks, heads)."""
+    if kind == "relu":
+        return jax.nn.relu(x)
+    if kind == "gelu_exact":  # erf form (falcon/gpt-neox/phi/bert)
+        return jax.nn.gelu(x, approximate=False)
+    if kind == "gelu":  # tanh approximation (gpt2's gelu_new, bloom)
+        return jax.nn.gelu(x, approximate=True)
+    if kind == "silu":
+        return jax.nn.silu(x)
+    raise ValueError(f"unknown activation {kind!r}")
 
 
 def _mlp_block(x, p, cfg: TransformerConfig):
@@ -414,13 +469,7 @@ def _mlp_block(x, p, cfg: TransformerConfig):
         if cfg.activation == "silu":
             return _lin(jax.nn.silu(_lin(x, p, "w_gate", "b_gate"))
                         * _lin(x, p, "w_in", "b_in"), p, "w_out", "b_out")
-        mid = _lin(x, p, "w_in", "b_in")
-        if cfg.activation == "relu":
-            mid = jax.nn.relu(mid)
-        elif cfg.activation == "gelu_exact":  # erf form (falcon/gpt-neox/phi)
-            mid = jax.nn.gelu(mid, approximate=False)
-        else:  # 'gelu': tanh approximation (gpt2's gelu_new)
-            mid = jax.nn.gelu(mid, approximate=True)
+        mid = apply_activation(_lin(x, p, "w_in", "b_in"), cfg.activation)
         return _lin(mid, p, "w_out", "b_out")
 
 
@@ -466,6 +515,8 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
         x = params["embed"]["tokens"].astype(dt)[tokens]
         if cfg.position == "learned":
             x = x + params["embed"]["position"].astype(dt)[None, :S]
+        if cfg.embed_norm:
+            x = _norm(x, params["embed_norm"], "layernorm", cfg.norm_eps)
     cos, sin = (None, None)
     if cfg.position == "rope":
         cos, sin = rope_table(S, cfg.rot_dim, cfg.rope_theta)

@@ -178,7 +178,7 @@ def test_converted_models_serve_through_inference_v1(devices, arch):
 def test_unsupported_arch_rejected(devices):
     with pytest.raises(ValueError, match="unsupported HF model_type"):
         load_hf_model({"fake.weight": np.zeros((2, 2))},
-                      {"model_type": "bert"})
+                      {"model_type": "t5"})
 
 
 def test_supported_architectures_surface(devices):
@@ -186,3 +186,11 @@ def test_supported_architectures_surface(devices):
     for required in ("llama", "mistral", "mixtral", "qwen2", "phi3",
                      "falcon", "gpt_neox", "opt", "gpt2"):
         assert required in archs, archs
+
+
+def test_bloom_golden(devices):
+    from transformers import BloomConfig
+
+    _golden(BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5, tie_word_embeddings=True))
