@@ -1170,6 +1170,13 @@ class TrainingEngine:
         return _load(self, load_dir, tag=tag,
                      load_optimizer_states=load_optimizer_states)
 
+    def load_universal_checkpoint(self, root: str, **kwargs) -> str:
+        """Ingest a DeepSpeed universal checkpoint (ds_to_universal.py
+        output) — reference ``universal_checkpoint.py:17``."""
+        from .checkpoint.universal import load_universal_checkpoint as _lu
+
+        return _lu(self, root, **kwargs)
+
     # -- phase-alternation state offload (reference: engine.py:5573
     # offload_states / reload_states — RLHF rollouts evict optimizer state
     # to free HBM for the KV cache, then reload before the next update) ---

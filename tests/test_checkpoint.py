@@ -96,3 +96,128 @@ def test_zero_to_fp32_cli(tmp_path, devices):
     expect = sum(l.size for l in jax.tree.leaves(engine.state.params))
     assert n == expect
     assert all(v.dtype == np.float32 for v in tensors.values())
+
+
+def test_universal_checkpoint_import(tmp_path, devices):
+    """Ingest a DeepSpeed universal checkpoint (ds_to_universal.py layout:
+    zero/<torch_param_name>/{fp32,exp_avg,exp_avg_sq,step}.pt) — params land
+    converted + resharded, Adam moments grafted, step restored.  Reference:
+    checkpoint/universal_checkpoint.py:17."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import transformer as tfm
+    from deepspeed_tpu.models.hf_integration import load_hf_model
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False)).eval()
+
+    # forge the universal layout the reference's ds_to_universal emits
+    tag = "global_step7"
+    zero = tmp_path / "uckpt" / tag / "zero"
+    for name, p in hf.state_dict().items():
+        d = zero / f"module.{name}"  # engine wrapper prefix, stripped on load
+        d.mkdir(parents=True)
+        t = p.detach().float()
+        torch.save({"param": t}, d / "fp32.pt")
+        torch.save({"param": t * 0.1}, d / "exp_avg.pt")
+        torch.save({"param": t.abs() * 0.01}, d / "exp_avg_sq.pt")
+        torch.save(7, d / "step.pt")
+    (tmp_path / "uckpt" / "latest_universal").write_text(tag)
+
+    # a FRESH engine (different random init) on the ZeRO-3 mesh
+    cfg, ref_params = load_hf_model(hf)
+    params0 = tfm.init_params(jax.random.PRNGKey(99), cfg)
+    spec = ModelSpec(loss_fn=lambda p, b, r: tfm.loss_fn(p, b, cfg),
+                     params=params0, param_axes=tfm.param_axes(cfg))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3}, "steps_per_print": 1000})
+
+    engine.load_universal_checkpoint(str(tmp_path / "uckpt"),
+                                     hf_config=hf.config)
+    assert engine.get_global_step() == 7
+
+    # params match the HF conversion exactly, resharded onto the mesh
+    for (pa, la), (pb, lb) in zip(
+            jax.tree_util.tree_flatten_with_path(engine.state.params)[0],
+            jax.tree_util.tree_flatten_with_path(ref_params)[0]):
+        assert pa == pb
+        np.testing.assert_allclose(np.asarray(jax.device_get(la)),
+                                   np.asarray(lb).astype(np.float32),
+                                   rtol=0, atol=0, err_msg=str(pa))
+    assert not engine.state.params["layers"]["mlp"]["w_in"] \
+        .sharding.is_fully_replicated
+
+    # Adam moments grafted (mu == 0.1 * converted params)
+    import optax
+
+    adam_states = [n for n in jax.tree_util.tree_leaves(
+        engine.state.opt_state,
+        is_leaf=lambda n: isinstance(n, optax.ScaleByAdamState))
+        if isinstance(n, optax.ScaleByAdamState)]
+    assert adam_states
+    mu_leaf = np.asarray(jax.device_get(
+        adam_states[0].mu["embed"]["tokens"]))
+    np.testing.assert_allclose(
+        mu_leaf, 0.1 * np.asarray(ref_params["embed"]["tokens"]), rtol=1e-6)
+    # warm moments MUST carry their step count (bias correction would
+    # otherwise overscale the first resumed update by ~1/(1-beta1))
+    assert int(adam_states[0].count) == 7
+
+    # training continues from the imported state
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        1, 128, (engine.train_batch_size, 16)).astype(np.int32)}
+    m = engine.train_batch(batch)
+    assert np.isfinite(float(m["loss"]))
+    assert engine.get_global_step() == 8
+
+
+def test_universal_import_transformer_prefixed_family(tmp_path, devices):
+    """gpt2/falcon/bloom universal checkpoints carry the module.transformer.
+    nesting — the importer must strip it down to the converter's schema."""
+    torch = pytest.importorskip("torch")
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import transformer as tfm
+    from deepspeed_tpu.models.hf_integration import load_hf_model
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    torch.manual_seed(1)
+    hf = GPT2LMHeadModel(GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64)).eval()
+    zero = tmp_path / "u" / "global_step3" / "zero"
+    for name, p in hf.state_dict().items():
+        if name == "lm_head.weight":
+            continue  # tied view; DS checkpoints store the module params
+        d = zero / f"module.{name}"
+        d.mkdir(parents=True)
+        torch.save({"param": p.detach().float()}, d / "fp32.pt")
+        torch.save({"param": p.detach().float() * 0.0}, d / "exp_avg.pt")
+        torch.save({"param": p.detach().float().abs() * 0.0},
+                   d / "exp_avg_sq.pt")
+    (tmp_path / "u" / "latest_universal").write_text("global_step3")
+
+    cfg, ref_params = load_hf_model(hf)
+    # fresh engine with the CONVERTED tree's structure (gpt2 carries linear
+    # bias leaves init_params does not create) but scrambled values
+    fresh = jax.tree.map(lambda x: np.zeros_like(np.asarray(x)), ref_params)
+    spec = ModelSpec(loss_fn=lambda p, b, r: tfm.loss_fn(p, b, cfg),
+                     params=fresh,
+                     param_axes=tfm.param_axes(cfg, params=ref_params))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000})
+    engine.load_universal_checkpoint(str(tmp_path / "u"), hf_config=hf.config)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(engine.state.params["embed"]["tokens"])),
+        np.asarray(ref_params["embed"]["tokens"]), rtol=0, atol=0)
