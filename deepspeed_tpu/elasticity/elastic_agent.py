@@ -130,12 +130,16 @@ class ElasticAgent:
     def _start_group(self, members: List[str]) -> None:
         coordinator = members[0]
         n = len(members)
+        # rotate the coordinator port per generation: the previous
+        # generation's listener can linger in TIME_WAIT after the group is
+        # torn down, and a bind failure would burn a restart (observed as
+        # back-to-back crashed generations in the scale-down test)
+        port = self.cfg.coordinator_port + (self.restart_count % 16)
         self.procs = []
         for pid, member in enumerate(members):
             env = dict(self.base_env)
             env.update({
-                "COORDINATOR_ADDRESS":
-                    f"{coordinator}:{self.cfg.coordinator_port}",
+                "COORDINATOR_ADDRESS": f"{coordinator}:{port}",
                 "NUM_PROCESSES": str(n),
                 "PROCESS_ID": str(pid),
                 "DSTPU_RESTART_COUNT": str(self.restart_count),
@@ -144,7 +148,7 @@ class ElasticAgent:
             self.procs.append(self.launch_fn(member, env))
         self.current_members = list(members)
         logger.info(f"elastic agent: started {n} workers "
-                    f"(restart {self.restart_count}): {members}")
+                    f"(restart {self.restart_count}, port {port}): {members}")
 
     def _stop_group(self) -> None:
         for p in self.procs:

@@ -1,0 +1,88 @@
+"""Training worker for the elastic scale-down/scale-up test.
+
+Each generation of the group runs this script: rendezvous from the agent's
+env (COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID), build the engine over
+whatever world exists, resume from the latest checkpoint, train toward the
+step target checkpointing every step, exit 0 at the target.  The universal-
+by-construction checkpoint layout is what makes the world-size change a
+non-event (reference: elastic_agent.py:127 restart loop + universal
+checkpoints).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def main() -> int:
+    import time
+
+    target = int(os.environ["DSTPU_TEST_TARGET_STEPS"])
+    ckpt_dir = os.environ["DSTPU_TEST_CKPT"]
+    progress = os.environ["DSTPU_TEST_PROGRESS"]
+    # deterministic pacing: with a warm compile cache the tiny step runs in
+    # ~0.3s and a scaled-down generation could FINISH before the crashed
+    # member's rejoin cool-down expires — the throttle keeps generation
+    # duration stable so the scale-up window always exists
+    step_sleep = float(os.environ.get("DSTPU_TEST_STEP_SLEEP", "0"))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from deepspeed_tpu import comm
+
+    comm.init_distributed()
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import transformer as tfm
+    from deepspeed_tpu.runtime.engine import ModelSpec
+    from tests.dist.workers import SEED
+
+    # deliberately MINIMAL model: gloo's context formation has a hard ~30s
+    # deadline, and on a 1-core host two ranks cold-compiling a bigger
+    # program starve each other past it — seconds-long compiles keep every
+    # generation's rendezvous comfortably inside the window
+    cfg = tfm.get_config("tiny", num_layers=1, hidden_size=32,
+                         intermediate_size=64, num_heads=2, max_seq_len=32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    spec = ModelSpec(loss_fn=lambda p, b, r: tfm.loss_fn(p, b, cfg),
+                     params=params, param_axes=tfm.param_axes(cfg))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10_000,
+    })
+    engine.load_checkpoint(ckpt_dir)  # warning-only no-op on first start
+
+    while engine.get_global_step() < target:
+        step = engine.get_global_step()
+        # batches keyed by GLOBAL step: every world generation sees the same
+        # data stream position regardless of its size
+        srng = np.random.default_rng(SEED + step)
+        batch = {"input_ids": srng.integers(
+            1, cfg.vocab_size,
+            (engine.train_batch_size, 16)).astype(np.int32)}
+        m = engine.train_batch(batch)
+        engine.save_checkpoint(ckpt_dir)
+        if step_sleep:
+            time.sleep(step_sleep)
+        if jax.process_index() == 0:
+            with open(progress, "a") as f:
+                f.write(json.dumps({
+                    "step": engine.get_global_step(),
+                    "procs": jax.process_count(),
+                    "devices": jax.device_count(),
+                    "loss": float(m["loss"]),
+                    "restart": int(os.environ.get("DSTPU_RESTART_COUNT", -1)),
+                }) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,118 @@
+"""Mid-run elastic scale-down AND scale-up (reference:
+``elasticity/elastic_agent.py:127 _invoke_run`` — restart + re-rendezvous on
+membership change).
+
+A real 2-process training group runs under the ElasticAgent; the test kills
+one worker mid-run (host failure).  The agent must re-form the group
+WITHOUT the crashed member (scale-down), keep training from the latest
+checkpoint, then — once the member's rejoin cool-down expires — re-admit it
+and re-form at full size (scale-up).  Training finishes at the step target
+with each generation resuming the same trajectory.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import AgentConfig, ElasticAgent
+from tests.dist.runner import _REPO_ROOT, free_port
+
+pytestmark = pytest.mark.slow
+
+# enough runway that the scaled-down generation is still mid-run when the
+# crashed member's cool-down expires (otherwise the job finishes at reduced
+# size and the scale-UP would never be observable)
+TARGET_STEPS = 30
+
+
+def test_kill_and_readd_worker(tmp_path):
+    progress = tmp_path / "progress.jsonl"
+    ckpt = tmp_path / "ckpt"
+    port = free_port()
+    import sys
+
+    env = {
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "DSTPU_ACCELERATOR": "cpu",
+        "DSTPU_TEST_TARGET_STEPS": str(TARGET_STEPS),
+        "DSTPU_TEST_STEP_SLEEP": "0.8",
+        "DSTPU_TEST_CKPT": str(ckpt),
+        "DSTPU_TEST_PROGRESS": str(progress),
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(_REPO_ROOT,
+                                                  ".jax_cache_tests"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "-1",
+        "PYTHONPATH": _REPO_ROOT,
+    }
+    import subprocess
+
+    def launch_logged(member, worker_env):
+        full = dict(os.environ)
+        full.update(env)
+        full.update(worker_env)
+        gen = worker_env["DSTPU_RESTART_COUNT"]
+        log = open(tmp_path / f"worker_{member}_gen{gen}.log", "w")
+        return subprocess.Popen(
+            [sys.executable, "-m", "tests.dist.elastic_worker"],
+            env=full, cwd=_REPO_ROOT, stdout=log, stderr=subprocess.STDOUT)
+
+    agent = ElasticAgent(
+        program=[sys.executable, "-m", "tests.dist.elastic_worker"],
+        members_fn=lambda: ["localhost", "localhost-b"],
+        agent_config=AgentConfig(
+            max_restarts=6, poll_interval_s=0.5, coordinator_port=port,
+            scale_up_delay_s=1.0, rejoin_cooldown_s=12.0,
+            member_max_fails=3),
+        launch_fn=launch_logged,
+        env=env)
+
+    rc_holder = {}
+
+    def run_agent():
+        os.chdir(_REPO_ROOT)
+        rc_holder["rc"] = agent.run()
+
+    t = threading.Thread(target=run_agent, daemon=True)
+    t.start()
+
+    # wait for real progress from the 2-process world, then kill member b
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if progress.exists() and len(progress.read_text().splitlines()) >= 2:
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail("group made no progress")
+    victim = agent.procs[1]  # member order is the members_fn order
+    victim.kill()
+
+    t.join(timeout=900)
+    if t.is_alive() or rc_holder.get("rc") != 0:
+        logs = "\n".join(
+            f"--- {f.name}\n" + f.read_text()[-800:]
+            for f in sorted(tmp_path.glob("worker_*.log")))
+        pytest.fail(f"agent rc={rc_holder.get('rc')} "
+                    f"alive={t.is_alive()}\n{logs}")
+
+    records = [json.loads(line)
+               for line in progress.read_text().splitlines()]
+    steps = [r["step"] for r in records]
+    assert steps[-1] == TARGET_STEPS
+    assert steps == sorted(steps)  # monotone resume, no step replays lost
+
+    procs_seen = [r["procs"] for r in records]
+    assert 1 in procs_seen, f"never trained scaled-DOWN: {procs_seen}"
+    down_at = procs_seen.index(1)
+    assert 2 in procs_seen[down_at:], \
+        f"never scaled back UP after the crash: {procs_seen}"
+    assert agent.restart_count >= 2  # one down, one up
+
+    # trajectory continuity: post-resume loss stays near the pre-crash
+    # trend, far below the fresh-init loss
+    first_loss = records[0]["loss"]
+    resumed = [r["loss"] for r in records[down_at:]]
+    assert min(resumed) < first_loss, (first_loss, resumed)
