@@ -232,6 +232,30 @@ def main() -> None:
         "model_params_m": round(cfg.num_params() / 1e6, 1),
         "device": accel.device_kind(),
     }
+    if on_tpu:
+        # Lever ablation (VERDICT r4 #1): the same compiled step re-timed
+        # with each single-chip lever disabled — no recompiles, seconds each.
+        ablation = {"baseline_step_s": round(dt, 4)}
+        t0 = time.perf_counter()  # input pipeline: re-place the batch per step
+        for _ in range(steps):
+            engine.train_batch(batch)
+        jax.device_get(engine.state.step)
+        ablation["no_preplaced_batch_step_s"] = round(
+            (time.perf_counter() - t0) / steps, 4)
+        t0 = time.perf_counter()  # async metrics: force a sync read per step
+        for _ in range(steps):
+            float(engine.train_batch(placed)["loss"])
+        ablation["sync_metrics_step_s"] = round(
+            (time.perf_counter() - t0) / steps, 4)
+        extra["ablation"] = ablation
+        if os.environ.get("DSTPU_BENCH_TRACE", "0") == "1":
+            trace_dir = os.path.join(_REPO, ".bench_trace")
+            jax.profiler.start_trace(trace_dir)
+            for _ in range(2):
+                engine.train_batch(placed)
+            jax.device_get(engine.state.step)
+            jax.profiler.stop_trace()
+            extra["trace_dir"] = trace_dir
     extra.update(telemetry)
     print(json.dumps({
         "metric": "train_step_mfu_0p6b_llama_1chip" if on_tpu else "train_step_mfu_smoke_cpu",

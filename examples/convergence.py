@@ -133,7 +133,20 @@ def main() -> int:
     p.add_argument("--micro_batch", type=int, default=2)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--out", default=None)
+    p.add_argument("--device", default="auto", choices=["auto", "cpu"],
+                   help="cpu pins the CPU backend via jax.config (the TPU "
+                        "plugin can hang init when its tunnel is down)")
+    p.add_argument("--cpu_devices", type=int, default=8)
     args = p.parse_args()
+    if args.device == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.cpu_devices}").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     r = run(args.preset, args.steps, args.seq, args.target,
             micro_batch=args.micro_batch, lr=args.lr, out=args.out)
     print(json.dumps({k: v for k, v in r.items() if k != "curve"}))

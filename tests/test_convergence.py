@@ -18,3 +18,28 @@ def test_byte_lm_converges_on_real_text(devices, tmp_path):
         f"(curve: {r['curve']})")
     # the curve must be genuinely decreasing, not noise around the start
     assert r["final_loss"] < r["initial_loss"] * 0.7
+
+
+def test_gpt2_125m_convergence_artifact():
+    """BASELINE.md ladder step 1 (GPT-2 125M to a target loss): the run is
+    executed by examples/convergence.py and its loss curve committed as
+    artifacts/gpt2_125m_convergence.json; this asserts the recorded result
+    so a regression in the recipe cannot silently ship.  (Reference role:
+    tests/model/ sanity tier.)"""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "artifacts", "gpt2_125m_convergence.json")
+    assert os.path.exists(path), \
+        "missing committed artifact — run examples/convergence.py " \
+        "--preset gpt2-125m"
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["preset"] == "gpt2-125m"
+    assert rec["passed"], rec
+    assert rec["final_loss"] <= rec["target"], rec
+    # real learning, not a flat curve: at least 1.5 nats below the
+    # ln(256)=5.55 uniform floor of byte-level modelling
+    assert rec["initial_loss"] - rec["final_loss"] > 1.5, rec
+    assert len(rec["curve"]) >= 5
