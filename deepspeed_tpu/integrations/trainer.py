@@ -247,7 +247,10 @@ class Trainer:
             epochs = 0  # step-bounded: iterate until max_steps
         logging_steps = int(_get(args, "logging_steps", 500) or 500)
         save_steps = int(_get(args, "save_steps", 0) or 0)
-        save_strategy = str(_get(args, "save_strategy", "no") or "no")
+        # transformers stores save_strategy as an IntervalStrategy enum whose
+        # str() is "IntervalStrategy.STEPS" — normalize like hf_args does
+        save_strategy = str(_get(args, "save_strategy", "no") or "no") \
+            .split(".")[-1].lower()
         output_dir = _get(args, "output_dir", None)
         seed = int(_get(args, "seed", 42))
 
@@ -293,7 +296,13 @@ class Trainer:
             losses.append(self.engine.eval_batch(batch)["loss"])
         out = {f"{metric_key_prefix}_loss": float(np.mean(losses))}
         if self.compute_metrics is not None:
-            out.update(self.compute_metrics(out))
+            # HF's contract hands compute_metrics an EvalPrediction with the
+            # full logits; this engine never materializes them (tiled loss) —
+            # failing loudly beats silently handing it the wrong object
+            raise NotImplementedError(
+                "compute_metrics needs materialized per-sample predictions, "
+                "which the TPU engine does not surface; compute metrics from "
+                "eval_loss or run a separate prediction pass")
         self.log(out)
         return out
 

@@ -49,7 +49,8 @@ class EncoderConfig:
 
     def num_params(self) -> int:
         h, f, L = self.hidden_size, self.intermediate_size, self.num_layers
-        per_layer = 4 * h * h + 2 * h * f + (4 + 2 + f + h) + 4 * h
+        # 4 projections + MLP, their biases (4h attn, f+h mlp), two LNs (4h)
+        per_layer = 4 * h * h + 2 * h * f + 9 * h + f
         embed = (self.vocab_size + self.max_seq_len + self.type_vocab_size) * h
         return L * per_layer + embed + 2 * h
 

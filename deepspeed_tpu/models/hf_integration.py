@@ -999,6 +999,11 @@ def encoder_config_from_hf(hf_config) -> "Any":
 
     get = _getter(hf_config)
     act = str(get("hidden_act", "gelu"))
+    # HF bert 'gelu' is the erf form; 'gelu_new' the tanh approximation
+    act_map = {"gelu": "gelu_exact", "gelu_new": "gelu", "relu": "relu"}
+    if act not in act_map:
+        raise ValueError(f"unsupported bert hidden_act {act!r}; "
+                         f"supported: {sorted(act_map)}")
     return EncoderConfig(
         vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
         intermediate_size=get("intermediate_size"),
@@ -1007,8 +1012,7 @@ def encoder_config_from_hf(hf_config) -> "Any":
         max_seq_len=get("max_position_embeddings", 512),
         type_vocab_size=get("type_vocab_size", 2),
         norm_eps=get("layer_norm_eps", 1e-12),
-        # HF bert 'gelu' is the erf form; 'gelu_new' the tanh approximation
-        activation="gelu" if act == "gelu_new" else "gelu_exact")
+        activation=act_map[act])
 
 
 def params_from_hf_bert(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:

@@ -502,6 +502,11 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
     Ulysses/ring sequence parallelism and expert-parallel MoE dispatch.
     """
     dt = jnp.dtype(cfg.dtype)
+    if cfg.position == "alibi" and cfg.attn_impl != "xla":
+        # the additive logit bias rides the einsum path only; the Pallas
+        # flash/ring kernels take no bias operand (mirror of the
+        # sliding_window constraint below)
+        raise ValueError("position='alibi' requires attn_impl='xla'")
     if attn_fn is None:
         attn_fn = resolve_attention(cfg.attn_impl)
         if cfg.sliding_window > 0:
