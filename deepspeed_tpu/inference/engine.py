@@ -127,6 +127,8 @@ def forward_cached(params, tokens, cache, start_pos, cfg: tfm.TransformerConfig)
         logits = x[:, -1] @ params["embed"]["tokens"].astype(dt).T
     else:
         logits = x[:, -1] @ params["lm_head"]["w"].astype(dt)
+        if "b" in params["lm_head"]:
+            logits = logits + params["lm_head"]["b"].astype(dt)
     new_cache = {"k": new_ks, "v": new_vs,
                  "length": cache["length"] + T}
     return logits.astype(jnp.float32), new_cache

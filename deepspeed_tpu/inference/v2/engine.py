@@ -191,6 +191,8 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
             logits = last_hidden @ params["embed"]["tokens"].astype(dt).T
         else:
             logits = last_hidden @ params["lm_head"]["w"].astype(dt)
+            if "b" in params["lm_head"]:
+                logits = logits + params["lm_head"]["b"].astype(dt)
         return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
     return jax.jit(fwd, donate_argnums=(1,))
@@ -324,6 +326,8 @@ def _decode_body(params, caches, token_ids, position_ids, block_tables,
         logits = x @ params["embed"]["tokens"].astype(dt).T
     else:
         logits = x @ params["lm_head"]["w"].astype(dt)
+        if "b" in params["lm_head"]:
+            logits = logits + params["lm_head"]["b"].astype(dt)
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 

@@ -84,6 +84,14 @@ class Trainer:
         self.train_dataset = train_dataset
         self.eval_dataset = eval_dataset
         self.processing_class = processing_class or tokenizer
+        if compute_metrics is not None:
+            # HF's contract hands compute_metrics an EvalPrediction with the
+            # full logits; this engine never materializes them (tiled loss) —
+            # fail at construction, before any training/eval is paid for
+            raise NotImplementedError(
+                "compute_metrics needs materialized per-sample predictions, "
+                "which the TPU engine does not surface; compute metrics from "
+                "eval_loss or run a separate prediction pass")
         self.compute_metrics = compute_metrics
         self.state = TrainerState()
 
@@ -115,6 +123,19 @@ class Trainer:
         if self._hf_config is not None:
             self._hf_model_type = getattr(self._hf_config, "model_type",
                                           "llama")
+
+        from ..models import t5 as t5m
+
+        if isinstance(cfg, t5m.T5ModelConfig):
+            # seq2seq family: labels pass through unshifted (t5.loss_fn does
+            # the decoder-input shift_right internally, HF-style)
+            self._is_encoder = True
+
+            def t5_loss(p, batch, rng):
+                return t5m.loss_fn(p, batch, cfg)
+
+            return ModelSpec(loss_fn=t5_loss, params=params,
+                             param_axes=t5m.param_axes(cfg))
 
         if isinstance(cfg, enc.EncoderConfig):
             # encoder family (BERT): MLM objective with HF's unshifted
@@ -295,14 +316,6 @@ class Trainer:
             batch = self._collate([ds[i] for i in range(lo, lo + tb)])
             losses.append(self.engine.eval_batch(batch)["loss"])
         out = {f"{metric_key_prefix}_loss": float(np.mean(losses))}
-        if self.compute_metrics is not None:
-            # HF's contract hands compute_metrics an EvalPrediction with the
-            # full logits; this engine never materializes them (tiled loss) —
-            # failing loudly beats silently handing it the wrong object
-            raise NotImplementedError(
-                "compute_metrics needs materialized per-sample predictions, "
-                "which the TPU engine does not surface; compute metrics from "
-                "eval_loss or run a separate prediction pass")
         self.log(out)
         return out
 

@@ -77,6 +77,19 @@ def config_from_hf(hf_config) -> tfm.TransformerConfig:
             norm="layernorm", activation="gelu_exact",
             norm_eps=get("layer_norm_epsilon", 1e-5),
             tie_embeddings=bool(get("tie_word_embeddings", True)))
+    if model_type == "gptj":
+        h = get("n_embd")
+        hd = h // get("n_head")
+        return tfm.TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=h,
+            intermediate_size=get("n_inner") or 4 * h,
+            num_layers=get("n_layer"), num_heads=get("n_head"),
+            max_seq_len=get("n_positions", 2048),
+            norm="layernorm", activation="gelu", position="rope",
+            parallel_residual=True,
+            partial_rotary_factor=(get("rotary_dim") or hd) / hd,
+            norm_eps=get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=False)
     if model_type == "bloom":
         if get("apply_residual_connection_post_layernorm", False):
             raise ValueError(
@@ -582,6 +595,68 @@ def params_to_hf_llama(params: Dict[str, Any], cfg: tfm.TransformerConfig
     return out
 
 
+def params_from_hf_gptj(state_dict: Dict[str, Any],
+                        cfg: tfm.TransformerConfig) -> Dict[str, Any]:
+    """GPT-J: separate unbiased q/k/v/out projections, ONE shared layernorm
+    per block (parallel residual — duplicated into ln1/ln2), partial rotary
+    in the INTERLEAVED even/odd convention (mesh-transformer heritage) —
+    exactly this repo's ``apply_rope``, so NO rotate_half permutation; the
+    untied lm_head carries a bias.  Reference policy:
+    ``module_inject/containers/gptj.py``."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L = cfg.num_layers
+    ln_scale = _lnorm(sd, "h.{}.ln_1.weight", L)
+    ln_bias = _lnorm(sd, "h.{}.ln_1.bias", L)
+    return {
+        "embed": {"tokens": sd["wte.weight"]},
+        "layers": {
+            "attn": {
+                "wq": _lw(sd, "h.{}.attn.q_proj.weight", L),
+                "wk": _lw(sd, "h.{}.attn.k_proj.weight", L),
+                "wv": _lw(sd, "h.{}.attn.v_proj.weight", L),
+                "wo": _lw(sd, "h.{}.attn.out_proj.weight", L),
+            },
+            "ln1": {"scale": ln_scale, "bias": ln_bias},
+            "ln2": {"scale": ln_scale.copy(), "bias": ln_bias.copy()},
+            "mlp": {
+                "w_in": _lw(sd, "h.{}.mlp.fc_in.weight", L),
+                "w_out": _lw(sd, "h.{}.mlp.fc_out.weight", L),
+                "b_in": _lnorm(sd, "h.{}.mlp.fc_in.bias", L),
+                "b_out": _lnorm(sd, "h.{}.mlp.fc_out.bias", L),
+            },
+        },
+        "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+        "lm_head": {"w": sd["lm_head.weight"].T, "b": sd["lm_head.bias"]},
+    }
+
+
+def params_to_hf_gptj(params: Dict[str, Any], cfg: tfm.TransformerConfig
+                      ) -> Dict[str, np.ndarray]:
+    """GPT-J export (shared-layernorm architecture: ln1 wins if training
+    diverged the duplicated copies)."""
+    lp = params["layers"]
+    out: Dict[str, np.ndarray] = {
+        "transformer.wte.weight": np.asarray(params["embed"]["tokens"]),
+        "transformer.ln_f.weight": np.asarray(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": np.asarray(params["final_norm"]["bias"]),
+        "lm_head.weight": np.asarray(params["lm_head"]["w"]).T,
+        "lm_head.bias": np.asarray(params["lm_head"]["b"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}"
+        out[f"{pre}.attn.q_proj.weight"] = np.asarray(lp["attn"]["wq"][i]).T
+        out[f"{pre}.attn.k_proj.weight"] = np.asarray(lp["attn"]["wk"][i]).T
+        out[f"{pre}.attn.v_proj.weight"] = np.asarray(lp["attn"]["wv"][i]).T
+        out[f"{pre}.attn.out_proj.weight"] = np.asarray(lp["attn"]["wo"][i]).T
+        out[f"{pre}.ln_1.weight"] = np.asarray(lp["ln1"]["scale"][i])
+        out[f"{pre}.ln_1.bias"] = np.asarray(lp["ln1"]["bias"][i])
+        out[f"{pre}.mlp.fc_in.weight"] = np.asarray(lp["mlp"]["w_in"][i]).T
+        out[f"{pre}.mlp.fc_in.bias"] = np.asarray(lp["mlp"]["b_in"][i])
+        out[f"{pre}.mlp.fc_out.weight"] = np.asarray(lp["mlp"]["w_out"][i]).T
+        out[f"{pre}.mlp.fc_out.bias"] = np.asarray(lp["mlp"]["b_out"][i])
+    return out
+
+
 def params_from_hf_bloom(state_dict: Dict[str, Any],
                          cfg: tfm.TransformerConfig) -> Dict[str, Any]:
     """BLOOM: ALiBi positions (no rotary permutation), embedding layernorm,
@@ -952,6 +1027,7 @@ ARCH_CONVERTERS: Dict[str, Callable] = {
     "opt": params_from_hf_opt,
     "gpt2": params_from_hf_gpt2,
     "bloom": params_from_hf_bloom,
+    "gptj": params_from_hf_gptj,
 }
 
 
@@ -968,6 +1044,7 @@ ARCH_EXPORTERS: Dict[str, Callable] = {
     "opt": params_to_hf_opt,
     "gpt2": params_to_hf_gpt2,
     "bloom": params_to_hf_bloom,
+    "gptj": params_to_hf_gptj,
 }
 
 
@@ -979,6 +1056,8 @@ def params_to_hf(params: Dict[str, Any], cfg: tfm.TransformerConfig,
     consolidated export the HF ecosystem reloads)."""
     if model_type == "bert":
         return params_to_hf_bert(params, cfg)
+    if model_type in ("t5", "mt5"):
+        return params_to_hf_t5(params, cfg)
     export = ARCH_EXPORTERS.get(model_type)
     if export is None:
         raise ValueError(
@@ -1128,8 +1207,181 @@ def params_to_hf_bert(params: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# encoder-decoder family (T5/mT5)
+# ---------------------------------------------------------------------------
+
+
+def t5_config_from_hf(hf_config) -> "Any":
+    from .t5 import T5ModelConfig
+
+    get = _getter(hf_config)
+    ff = str(get("feed_forward_proj", "relu"))
+    if ff not in ("relu", "gated-gelu"):
+        raise ValueError(f"unsupported T5 feed_forward_proj {ff!r}; "
+                         f"supported: relu, gated-gelu")
+    return T5ModelConfig(
+        vocab_size=get("vocab_size"), d_model=get("d_model"),
+        d_kv=get("d_kv"), d_ff=get("d_ff"),
+        num_layers=get("num_layers"),
+        num_decoder_layers=get("num_decoder_layers") or get("num_layers"),
+        num_heads=get("num_heads"),
+        relative_attention_num_buckets=get(
+            "relative_attention_num_buckets", 32),
+        relative_attention_max_distance=get(
+            "relative_attention_max_distance", 128),
+        feed_forward=ff,
+        tie_word_embeddings=bool(get("tie_word_embeddings", True)),
+        decoder_start_token_id=get("decoder_start_token_id", 0) or 0,
+        norm_eps=get("layer_norm_epsilon", 1e-6))
+
+
+def params_from_hf_t5(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """T5ForConditionalGeneration state dict → encoder-decoder pytree.  The
+    per-stack relative bias is read from block 0 (every block shares it)."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    gated = cfg.feed_forward == "gated-gelu"
+
+    def stack_w(pattern, L):
+        return _stack([sd[pattern.format(i)].T for i in range(L)])
+
+    def stack_n(pattern, L):
+        return _stack([sd[pattern.format(i)] for i in range(L)])
+
+    def attn_block(base, L, attn_name):
+        return {
+            "wq": stack_w(f"{base}.block.{{}}.layer.{attn_name[0]}"
+                          f".{attn_name[1]}.q.weight", L),
+            "wk": stack_w(f"{base}.block.{{}}.layer.{attn_name[0]}"
+                          f".{attn_name[1]}.k.weight", L),
+            "wv": stack_w(f"{base}.block.{{}}.layer.{attn_name[0]}"
+                          f".{attn_name[1]}.v.weight", L),
+            "wo": stack_w(f"{base}.block.{{}}.layer.{attn_name[0]}"
+                          f".{attn_name[1]}.o.weight", L),
+        }
+
+    def mlp_block(base, L, idx):
+        if gated:
+            return {
+                "wi_0": stack_w(f"{base}.block.{{}}.layer.{idx}"
+                                f".DenseReluDense.wi_0.weight", L),
+                "wi_1": stack_w(f"{base}.block.{{}}.layer.{idx}"
+                                f".DenseReluDense.wi_1.weight", L),
+                "wo": stack_w(f"{base}.block.{{}}.layer.{idx}"
+                              f".DenseReluDense.wo.weight", L),
+            }
+        return {
+            "wi": stack_w(f"{base}.block.{{}}.layer.{idx}"
+                          f".DenseReluDense.wi.weight", L),
+            "wo": stack_w(f"{base}.block.{{}}.layer.{idx}"
+                          f".DenseReluDense.wo.weight", L),
+        }
+
+    Le, Ld = cfg.num_layers, cfg.num_decoder_layers
+    params: Dict[str, Any] = {
+        "shared": {"tokens": sd["shared.weight"]},
+        "encoder": {
+            "layers": {
+                "attn": attn_block("encoder", Le, (0, "SelfAttention")),
+                "ln1": {"scale": stack_n(
+                    "encoder.block.{}.layer.0.layer_norm.weight", Le)},
+                "mlp": mlp_block("encoder", Le, 1),
+                "ln2": {"scale": stack_n(
+                    "encoder.block.{}.layer.1.layer_norm.weight", Le)},
+            },
+            "rel_bias": sd["encoder.block.0.layer.0.SelfAttention"
+                           ".relative_attention_bias.weight"],
+            "final_norm": {"scale": sd["encoder.final_layer_norm.weight"]},
+        },
+        "decoder": {
+            "layers": {
+                "self_attn": attn_block("decoder", Ld, (0, "SelfAttention")),
+                "ln1": {"scale": stack_n(
+                    "decoder.block.{}.layer.0.layer_norm.weight", Ld)},
+                "cross_attn": attn_block("decoder", Ld, (1, "EncDecAttention")),
+                "ln2": {"scale": stack_n(
+                    "decoder.block.{}.layer.1.layer_norm.weight", Ld)},
+                "mlp": mlp_block("decoder", Ld, 2),
+                "ln3": {"scale": stack_n(
+                    "decoder.block.{}.layer.2.layer_norm.weight", Ld)},
+            },
+            "rel_bias": sd["decoder.block.0.layer.0.SelfAttention"
+                           ".relative_attention_bias.weight"],
+            "final_norm": {"scale": sd["decoder.final_layer_norm.weight"]},
+        },
+    }
+    if not cfg.tie_word_embeddings and "lm_head.weight" in sd:
+        params["lm_head"] = {"w": sd["lm_head.weight"].T}
+    return params
+
+
+def params_to_hf_t5(params: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    """Reverse export to the T5ForConditionalGeneration schema (tied
+    embed_tokens copies included, as HF serializes them)."""
+    gated = cfg.feed_forward == "gated-gelu"
+    shared = np.asarray(params["shared"]["tokens"])
+    out: Dict[str, np.ndarray] = {
+        "shared.weight": shared,
+        "encoder.embed_tokens.weight": shared,
+        "decoder.embed_tokens.weight": shared,
+        "encoder.final_layer_norm.weight": np.asarray(
+            params["encoder"]["final_norm"]["scale"]),
+        "decoder.final_layer_norm.weight": np.asarray(
+            params["decoder"]["final_norm"]["scale"]),
+        "encoder.block.0.layer.0.SelfAttention.relative_attention_bias"
+        ".weight": np.asarray(params["encoder"]["rel_bias"]),
+        "decoder.block.0.layer.0.SelfAttention.relative_attention_bias"
+        ".weight": np.asarray(params["decoder"]["rel_bias"]),
+    }
+
+    def put_attn(base, idx, name, p, i):
+        for ours, theirs in (("wq", "q"), ("wk", "k"), ("wv", "v"),
+                             ("wo", "o")):
+            out[f"{base}.layer.{idx}.{name}.{theirs}.weight"] = \
+                np.asarray(p[ours][i]).T
+
+    def put_mlp(base, idx, p, i):
+        if gated:
+            out[f"{base}.layer.{idx}.DenseReluDense.wi_0.weight"] = \
+                np.asarray(p["wi_0"][i]).T
+            out[f"{base}.layer.{idx}.DenseReluDense.wi_1.weight"] = \
+                np.asarray(p["wi_1"][i]).T
+        else:
+            out[f"{base}.layer.{idx}.DenseReluDense.wi.weight"] = \
+                np.asarray(p["wi"][i]).T
+        out[f"{base}.layer.{idx}.DenseReluDense.wo.weight"] = \
+            np.asarray(p["wo"][i]).T
+
+    enc = params["encoder"]["layers"]
+    for i in range(cfg.num_layers):
+        base = f"encoder.block.{i}"
+        put_attn(base, 0, "SelfAttention", enc["attn"], i)
+        out[f"{base}.layer.0.layer_norm.weight"] = \
+            np.asarray(enc["ln1"]["scale"][i])
+        put_mlp(base, 1, enc["mlp"], i)
+        out[f"{base}.layer.1.layer_norm.weight"] = \
+            np.asarray(enc["ln2"]["scale"][i])
+    dec = params["decoder"]["layers"]
+    for i in range(cfg.num_decoder_layers):
+        base = f"decoder.block.{i}"
+        put_attn(base, 0, "SelfAttention", dec["self_attn"], i)
+        out[f"{base}.layer.0.layer_norm.weight"] = \
+            np.asarray(dec["ln1"]["scale"][i])
+        put_attn(base, 1, "EncDecAttention", dec["cross_attn"], i)
+        out[f"{base}.layer.1.layer_norm.weight"] = \
+            np.asarray(dec["ln2"]["scale"][i])
+        put_mlp(base, 2, dec["mlp"], i)
+        out[f"{base}.layer.2.layer_norm.weight"] = \
+            np.asarray(dec["ln3"]["scale"][i])
+    if cfg.tie_word_embeddings:
+        out["lm_head.weight"] = shared
+    elif "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]["w"]).T
+    return out
+
+
 def supported_architectures() -> tuple:
-    return tuple(sorted(ARCH_CONVERTERS)) + ("bert",)
+    return tuple(sorted(ARCH_CONVERTERS)) + ("bert", "t5", "mt5")
 
 
 def load_hf_model(model_name_or_sd, hf_config=None,
@@ -1149,6 +1401,9 @@ def load_hf_model(model_name_or_sd, hf_config=None,
     if model_type == "bert":  # encoder family: its own config + schema
         ecfg = encoder_config_from_hf(hf_config)
         return ecfg, params_from_hf_bert(sd, ecfg)
+    if model_type in ("t5", "mt5"):  # encoder-decoder family
+        tcfg = t5_config_from_hf(hf_config)
+        return tcfg, params_from_hf_t5(sd, tcfg)
     cfg = config_from_hf(hf_config)
     convert = ARCH_CONVERTERS.get(model_type)
     if convert is None:

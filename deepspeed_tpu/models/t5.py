@@ -1,0 +1,310 @@
+"""T5-family encoder-decoder (relative position biases, cross-attention).
+
+Closes the encoder-decoder gap of the model zoo (reference: the T5 policy in
+``module_inject`` and encoder-decoder inference containers).  TPU-first like
+the siblings: stacked-and-scanned blocks, logical axes for ZeRO/TP sharding,
+static shapes throughout.
+
+T5's architectural signatures, reproduced exactly:
+
+* **T5LayerNorm** = RMSNorm (no mean subtraction, no bias);
+* **unscaled attention** (no 1/sqrt(d) — folded into the init);
+* **relative position bias**: a learned (buckets, heads) table owned by the
+  FIRST block of each stack and shared by every layer — bidirectional
+  buckets in the encoder, causal in the decoder; cross-attention carries no
+  bias;
+* separate ``d_kv`` (inner head dim need not divide d_model);
+* MLP ``relu`` or ``gated-gelu`` (wi_0·gelu × wi_1);
+* tied head scales logits by ``d_model**-0.5``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class T5ModelConfig:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6            # encoder depth
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    feed_forward: str = "relu"     # relu | gated-gelu
+    tie_word_embeddings: bool = True
+    decoder_start_token_id: int = 0
+    norm_eps: float = 1e-6
+    dtype: str = "float32"
+    param_dtype: str = "float32"
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.d_kv
+
+
+def _dense(key, shape, fan_in, dtype):
+    import math
+
+    return (jax.random.normal(key, shape) / math.sqrt(fan_in)).astype(dtype)
+
+
+def _attn_params(keys, L, d, inner, pd):
+    return {
+        "wq": _dense(keys[0], (L, d, inner), d, pd),
+        "wk": _dense(keys[1], (L, d, inner), d, pd),
+        "wv": _dense(keys[2], (L, d, inner), d, pd),
+        "wo": _dense(keys[3], (L, inner, d), inner, pd),
+    }
+
+
+def _mlp_params(keys, L, d, f, gated, pd):
+    p = {"wo": _dense(keys[0], (L, f, d), f, pd)}
+    if gated:
+        p["wi_0"] = _dense(keys[1], (L, d, f), d, pd)
+        p["wi_1"] = _dense(keys[2], (L, d, f), d, pd)
+    else:
+        p["wi"] = _dense(keys[1], (L, d, f), d, pd)
+    return p
+
+
+def init_params(rng: jax.Array, cfg: T5ModelConfig) -> Dict[str, Any]:
+    pd = jnp.dtype(cfg.param_dtype)
+    d, f, inner = cfg.d_model, cfg.d_ff, cfg.inner_dim
+    Le, Ld = cfg.num_layers, cfg.num_decoder_layers
+    gated = cfg.feed_forward == "gated-gelu"
+    k = jax.random.split(rng, 24)
+    ones = lambda *s: jnp.ones(s, pd)  # noqa: E731
+    params: Dict[str, Any] = {
+        "shared": {"tokens": _dense(k[0], (cfg.vocab_size, d), d, pd)},
+        "encoder": {
+            "layers": {
+                "attn": _attn_params(k[1:5], Le, d, inner, pd),
+                "ln1": {"scale": ones(Le, d)},
+                "mlp": _mlp_params(k[5:8], Le, d, f, gated, pd),
+                "ln2": {"scale": ones(Le, d)},
+            },
+            "rel_bias": _dense(k[8], (cfg.relative_attention_num_buckets,
+                                      cfg.num_heads), cfg.num_heads, pd),
+            "final_norm": {"scale": ones(d)},
+        },
+        "decoder": {
+            "layers": {
+                "self_attn": _attn_params(k[9:13], Ld, d, inner, pd),
+                "ln1": {"scale": ones(Ld, d)},
+                "cross_attn": _attn_params(k[13:17], Ld, d, inner, pd),
+                "ln2": {"scale": ones(Ld, d)},
+                "mlp": _mlp_params(k[17:20], Ld, d, f, gated, pd),
+                "ln3": {"scale": ones(Ld, d)},
+            },
+            "rel_bias": _dense(k[20], (cfg.relative_attention_num_buckets,
+                                       cfg.num_heads), cfg.num_heads, pd),
+            "final_norm": {"scale": ones(d)},
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"w": _dense(k[21], (d, cfg.vocab_size), d, pd)}
+    return params
+
+
+def param_axes(cfg: T5ModelConfig) -> Dict[str, Any]:
+    attn = {"wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "heads"),
+            "wv": ("layers", "embed", "heads"),
+            "wo": ("layers", "heads", "embed")}
+    gated = cfg.feed_forward == "gated-gelu"
+    mlp = {"wo": ("layers", "mlp", "embed")}
+    if gated:
+        mlp["wi_0"] = mlp["wi_1"] = ("layers", "embed", "mlp")
+    else:
+        mlp["wi"] = ("layers", "embed", "mlp")
+    ln = {"scale": ("layers", "embed")}
+    axes: Dict[str, Any] = {
+        "shared": {"tokens": ("vocab", "embed")},
+        "encoder": {
+            "layers": {"attn": dict(attn), "ln1": dict(ln),
+                       "mlp": dict(mlp), "ln2": dict(ln)},
+            "rel_bias": (None, "heads"),
+            "final_norm": {"scale": ("embed",)},
+        },
+        "decoder": {
+            "layers": {"self_attn": dict(attn), "ln1": dict(ln),
+                       "cross_attn": dict(attn), "ln2": dict(ln),
+                       "mlp": dict(mlp), "ln3": dict(ln)},
+            "rel_bias": (None, "heads"),
+            "final_norm": {"scale": ("embed",)},
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        axes["lm_head"] = {"w": ("embed", "vocab")}
+    return axes
+
+
+def _rms(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + eps).astype(x.dtype)) * scale.astype(x.dtype)
+
+
+def relative_position_bucket(relative_position: jax.Array,
+                             bidirectional: bool, num_buckets: int,
+                             max_distance: int) -> jax.Array:
+    """Exact semantics of HF's ``T5Attention._relative_position_bucket``."""
+    rel = relative_position
+    buckets = jnp.zeros_like(rel)
+    if bidirectional:
+        num_buckets //= 2
+        buckets = buckets + (rel > 0).astype(rel.dtype) * num_buckets
+        rel = jnp.abs(rel)
+    else:
+        rel = -jnp.minimum(rel, 0)
+    max_exact = num_buckets // 2
+    is_small = rel < max_exact
+    rel_f = jnp.maximum(rel.astype(jnp.float32), 1.0)
+    large = max_exact + (
+        jnp.log(rel_f / max_exact) / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(rel.dtype)
+    large = jnp.minimum(large, num_buckets - 1)
+    return buckets + jnp.where(is_small, rel, large)
+
+
+def _position_bias(rel_table: jax.Array, q_len: int, k_len: int,
+                   bidirectional: bool, cfg: T5ModelConfig) -> jax.Array:
+    """(1, heads, q, k) additive logit bias shared by every layer of a
+    stack (HF: owned by block 0, passed down)."""
+    ctx = jnp.arange(q_len)[:, None]
+    mem = jnp.arange(k_len)[None, :]
+    buckets = relative_position_bucket(
+        mem - ctx, bidirectional, cfg.relative_attention_num_buckets,
+        cfg.relative_attention_max_distance)
+    bias = rel_table[buckets]                     # (q, k, heads)
+    return jnp.transpose(bias, (2, 0, 1))[None]    # (1, h, q, k)
+
+
+def _attend(x_q, x_kv, p, bias, cfg: T5ModelConfig):
+    """UNSCALED multi-head attention with additive logit bias."""
+    dt = x_q.dtype
+    B, Q, _ = x_q.shape
+    K = x_kv.shape[1]
+    h, dk = cfg.num_heads, cfg.d_kv
+    q = (x_q @ p["wq"].astype(dt)).reshape(B, Q, h, dk)
+    k = (x_kv @ p["wk"].astype(dt)).reshape(B, K, h, dk)
+    v = (x_kv @ p["wv"].astype(dt)).reshape(B, K, h, dk)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, Q, h * dk)
+    return o @ p["wo"].astype(dt)
+
+
+def _ff(x, p, cfg: T5ModelConfig):
+    dt = x.dtype
+    if cfg.feed_forward == "gated-gelu":
+        mid = jax.nn.gelu(x @ p["wi_0"].astype(dt), approximate=True) * \
+            (x @ p["wi_1"].astype(dt))
+    else:
+        mid = jax.nn.relu(x @ p["wi"].astype(dt))
+    return mid @ p["wo"].astype(dt)
+
+
+def _pad_bias(attention_mask: Optional[jax.Array]) -> Optional[jax.Array]:
+    if attention_mask is None:
+        return None
+    return jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9)
+
+
+def encode(params: Dict[str, Any], input_ids: jax.Array, cfg: T5ModelConfig,
+           attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    dt = jnp.dtype(cfg.dtype)
+    enc = params["encoder"]
+    S = input_ids.shape[1]
+    x = params["shared"]["tokens"].astype(dt)[input_ids]
+    bias = _position_bias(enc["rel_bias"], S, S, True, cfg)
+    pad = _pad_bias(attention_mask)
+    if pad is not None:
+        bias = bias + pad
+
+    def body(x, lp):
+        n1 = _rms(x, lp["ln1"]["scale"], cfg.norm_eps)  # k/v read the SAME
+        x = x + _attend(n1, n1, lp["attn"], bias, cfg)  # normed stream as q
+        x = x + _ff(_rms(x, lp["ln2"]["scale"], cfg.norm_eps), lp["mlp"], cfg)
+        return x, None
+
+    x, _ = lax.scan(jax.checkpoint(body), x, enc["layers"])
+    return _rms(x, enc["final_norm"]["scale"], cfg.norm_eps)
+
+
+def decode(params: Dict[str, Any], decoder_input_ids: jax.Array,
+           encoder_hidden: jax.Array, cfg: T5ModelConfig,
+           encoder_attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    dt = jnp.dtype(cfg.dtype)
+    dec = params["decoder"]
+    T = decoder_input_ids.shape[1]
+    x = params["shared"]["tokens"].astype(dt)[decoder_input_ids]
+    bias = _position_bias(dec["rel_bias"], T, T, False, cfg)
+    causal = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], 0.0, -1e9)
+    self_bias = bias + causal
+    cross_bias = _pad_bias(encoder_attention_mask)
+
+    def body(x, lp):
+        n1 = _rms(x, lp["ln1"]["scale"], cfg.norm_eps)
+        x = x + _attend(n1, n1, lp["self_attn"], self_bias, cfg)
+        x = x + _attend(_rms(x, lp["ln2"]["scale"], cfg.norm_eps),
+                        encoder_hidden, lp["cross_attn"], cross_bias, cfg)
+        x = x + _ff(_rms(x, lp["ln3"]["scale"], cfg.norm_eps), lp["mlp"], cfg)
+        return x, None
+
+    x, _ = lax.scan(jax.checkpoint(body), x, dec["layers"])
+    return _rms(x, dec["final_norm"]["scale"], cfg.norm_eps)
+
+
+def forward(params: Dict[str, Any], input_ids: jax.Array,
+            decoder_input_ids: jax.Array, cfg: T5ModelConfig,
+            attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """(input_ids, decoder_input_ids) → decoder logits (B, T, V)."""
+    dt = jnp.dtype(cfg.dtype)
+    hidden = encode(params, input_ids, cfg, attention_mask)
+    x = decode(params, decoder_input_ids, hidden, cfg, attention_mask)
+    if cfg.tie_word_embeddings:
+        # T5 scales the tied head (d_model**-0.5) — init-variance folding
+        x = x * (cfg.d_model ** -0.5)
+        return x @ params["shared"]["tokens"].astype(dt).T
+    return x @ params["lm_head"]["w"].astype(dt)
+
+
+def shift_right(labels: jax.Array, cfg: T5ModelConfig) -> jax.Array:
+    """HF ``_shift_right``: decoder inputs = labels shifted right with the
+    start token, -100 replaced by pad (0)."""
+    start = jnp.full_like(labels[:, :1], cfg.decoder_start_token_id)
+    shifted = jnp.concatenate([start, labels[:, :-1]], axis=1)
+    return jnp.where(shifted == -100, 0, shifted)
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+            cfg: T5ModelConfig):
+    """Seq2seq CE.  batch: {'input_ids', 'labels'} (+ optional
+    'attention_mask', 'decoder_input_ids')."""
+    labels = batch["labels"]
+    dec_in = batch.get("decoder_input_ids")
+    if dec_in is None:
+        dec_in = shift_right(labels, cfg)
+    logits = forward(params, batch["input_ids"], dec_in, cfg,
+                     batch.get("attention_mask"))
+    mask = (labels != -100).astype(jnp.float32)
+    safe = jnp.where(labels == -100, 0, labels)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    loss = jnp.sum(nll * mask) / denom
+    return loss, {"loss": loss,
+                  "accuracy": jnp.sum((jnp.argmax(logits, -1) == labels)
+                                      * mask) / denom,
+                  "tokens": denom}

@@ -281,6 +281,8 @@ def param_axes(cfg: TransformerConfig, params: Optional[Dict[str, Any]] = None
         axes["embed_norm"] = {"scale": ("embed",), "bias": ("embed",)}
     if not cfg.tie_embeddings:
         axes["lm_head"] = {"w": ("embed", "vocab")}
+        if params is not None and "b" in params.get("lm_head", {}):
+            axes["lm_head"]["b"] = ("vocab",)
 
     if params is not None:  # add axes for optional bias leaves
         bias_axes = {
@@ -583,6 +585,8 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: TransformerConfig,
             logits = x @ params["embed"]["tokens"].astype(dt).T
         else:
             logits = x @ params["lm_head"]["w"].astype(dt)
+            if "b" in params["lm_head"]:  # gpt-j ties off with a bias
+                logits = logits + params["lm_head"]["b"].astype(dt)
     return logits
 
 

@@ -245,7 +245,10 @@ def _head_loss(h, head_params, labels, mask, cfg):
 
     dt = jnp.dtype(cfg.dtype)
     h = tfm._norm(h, head_params["final_norm"], cfg.norm, cfg.norm_eps)
-    logits = (h @ head_params["w"].astype(dt)).astype(jnp.float32)
+    logits = h @ head_params["w"].astype(dt)
+    if "b" in head_params:
+        logits = logits + head_params["b"].astype(dt)
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     correct = ((logits.argmax(-1) == labels).astype(jnp.float32) * mask).sum()
@@ -475,6 +478,8 @@ def pipeline_loss_fn(params, batch, cfg, num_microbatches: int = 2,
         else:
             w = params["lm_head"]["w"]
         head_params = {"final_norm": params["final_norm"], "w": w}
+        if not cfg.tie_embeddings and "b" in params["lm_head"]:
+            head_params["b"] = params["lm_head"]["b"]  # gpt-j head bias
         f = _make_1f1b_fn(cfg, M, attn_fn, topo)
         loss_sum, correct_sum = f(params["layers"], head_params, x, labels,
                                   mask)
@@ -494,6 +499,8 @@ def pipeline_loss_fn(params, batch, cfg, num_microbatches: int = 2,
         logits = x @ params["embed"]["tokens"].astype(dt).T
     else:
         logits = x @ params["lm_head"]["w"].astype(dt)
+        if "b" in params["lm_head"]:
+            logits = logits + params["lm_head"]["b"].astype(dt)
 
     labels, mask = tfm.shift_labels(batch)
     if mask is None:

@@ -62,7 +62,8 @@ def tiled_mlp(x: jax.Array, p: Dict[str, Any], cfg, tile_size: int) -> jax.Array
 def tiled_logits_loss(x: jax.Array, embed_or_head: jax.Array,
                       labels: jax.Array, tile_size: int,
                       mask: Optional[jax.Array] = None,
-                      transpose_head: bool = False
+                      transpose_head: bool = False,
+                      head_bias: Optional[jax.Array] = None
                       ) -> Tuple[jax.Array, jax.Array]:
     """Fused tiled cross-entropy. x: (B, S, H) final hidden states;
     ``embed_or_head``: (V, H) embedding (tied, ``transpose_head=True``) or
@@ -90,7 +91,10 @@ def tiled_logits_loss(x: jax.Array, embed_or_head: jax.Array,
     def body(carry, inp):
         nll_sum, correct_sum = carry
         xi, li, mi = inp
-        logits = (xi @ w.T if transpose_head else xi @ w).astype(jnp.float32)
+        logits = xi @ w.T if transpose_head else xi @ w
+        if head_bias is not None:  # gpt-j untied head carries a bias
+            logits = logits + head_bias.astype(logits.dtype)
+        logits = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, li[..., None], axis=-1)[..., 0]
         nll_sum = nll_sum + (nll * mi).sum()
@@ -121,11 +125,13 @@ def tiled_loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array], cfg,
     dt = jnp.dtype(cfg.dtype)
     x = tfm.forward_hidden(params, tokens, cfg, attn_fn=attn_fn)
     if cfg.tie_embeddings:
-        w, transpose = params["embed"]["tokens"].astype(dt), True
+        w, transpose, hb = params["embed"]["tokens"].astype(dt), True, None
     else:
         w, transpose = params["lm_head"]["w"].astype(dt), False
+        hb = params["lm_head"].get("b")
     nll_sum, correct_sum = tiled_logits_loss(x, w, labels, tile_size,
-                                             mask=mask, transpose_head=transpose)
+                                             mask=mask, transpose_head=transpose,
+                                             head_bias=hb)
     denom = jnp.maximum(mask.astype(jnp.float32).sum(), 1.0)
     loss = nll_sum / denom
     return loss, {"loss": loss, "accuracy": correct_sum / denom,

@@ -130,7 +130,7 @@ def test_opt_golden(devices):
         do_layer_norm_before=True, word_embed_proj_dim=64))
 
 
-@pytest.mark.parametrize("arch", ["qwen2", "gpt_neox", "opt"])
+@pytest.mark.parametrize("arch", ["qwen2", "gpt_neox", "opt", "gptj"])
 def test_converted_models_serve_through_inference_v1(devices, arch):
     """The KV-cache inference engine must honor the new architecture features
     (projection biases, parallel residual, partial rotary, learned offset
@@ -151,6 +151,11 @@ def test_converted_models_serve_through_inference_v1(devices, arch):
                                num_attention_heads=4, rotary_pct=0.25,
                                use_parallel_residual=True,
                                max_position_embeddings=64)
+    elif arch == "gptj":
+        from transformers import GPTJConfig
+        hf_cfg = GPTJConfig(vocab_size=128, n_embd=64, n_layer=2, n_head=4,
+                            rotary_dim=8, n_positions=64,
+                            tie_word_embeddings=False)
     else:
         from transformers import OPTConfig
         hf_cfg = OPTConfig(vocab_size=128, hidden_size=64, ffn_dim=256,
@@ -178,7 +183,7 @@ def test_converted_models_serve_through_inference_v1(devices, arch):
 def test_unsupported_arch_rejected(devices):
     with pytest.raises(ValueError, match="unsupported HF model_type"):
         load_hf_model({"fake.weight": np.zeros((2, 2))},
-                      {"model_type": "t5"})
+                      {"model_type": "whisper"})
 
 
 def test_supported_architectures_surface(devices):
@@ -194,3 +199,11 @@ def test_bloom_golden(devices):
     _golden(BloomConfig(
         vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
         layer_norm_epsilon=1e-5, tie_word_embeddings=True))
+
+
+def test_gptj_golden(devices):
+    from transformers import GPTJConfig
+
+    _golden(GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
+        n_positions=64, tie_word_embeddings=False))

@@ -179,3 +179,13 @@ def test_bloom_export_roundtrip():
         vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
         tie_word_embeddings=True)).eval()
     _roundtrip(m)
+
+
+def test_gptj_export_roundtrip():
+    from transformers import GPTJConfig, GPTJForCausalLM
+
+    torch.manual_seed(0)
+    m = GPTJForCausalLM(GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
+        n_positions=64, tie_word_embeddings=False)).eval()
+    _roundtrip(m)

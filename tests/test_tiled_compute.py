@@ -57,3 +57,25 @@ def test_tiled_loss_gradients_match(devices, tiny):
     g_d = jax.grad(lambda p: tfm.loss_fn(p, batch, cfg)[0])(params)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-4), g_t, g_d)
+
+
+def test_tiled_loss_carries_head_bias(devices):
+    """GPT-J-style untied head with bias: the tiled CE must equal the dense
+    loss (the bias participates in every tile)."""
+    import jax
+
+    from deepspeed_tpu.models import transformer as tfm
+    from deepspeed_tpu.sequence.tiled_compute import tiled_loss_fn
+
+    cfg = tfm.get_config("tiny", tie_embeddings=False, dtype="float32",
+                         param_dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    params["lm_head"]["b"] = jax.random.normal(
+        jax.random.PRNGKey(1), (cfg.vocab_size,)) * 0.5
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 32)).astype(np.int32)}
+    l_dense, m_dense = tfm.loss_fn(params, batch, cfg)
+    l_tiled, m_tiled = tiled_loss_fn(params, batch, cfg, tile_size=8)
+    np.testing.assert_allclose(float(l_tiled), float(l_dense), rtol=1e-6)
+    np.testing.assert_allclose(float(m_tiled["accuracy"]),
+                               float(m_dense["accuracy"]), rtol=1e-6)
