@@ -119,6 +119,23 @@ def config_from_hf(hf_config) -> tfm.TransformerConfig:
             norm="layernorm", activation="relu", position="learned",
             norm_eps=1e-5,
             tie_embeddings=bool(get("tie_word_embeddings", True)))
+    if model_type == "phi":  # phi-1/phi-1.5/phi-2
+        if get("qk_layernorm", False):
+            raise ValueError(
+                "phi qk_layernorm=True (per-head q/k layernorms) is not "
+                "supported by the conversion")
+        return tfm.TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            num_kv_heads=get("num_key_value_heads"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            rope_theta=get("rope_theta", 10000.0),
+            partial_rotary_factor=get("partial_rotary_factor", 0.5),
+            parallel_residual=True, norm="layernorm", activation="gelu",
+            norm_eps=get("layer_norm_eps", 1e-5),
+            tie_embeddings=bool(get("tie_word_embeddings", False)))
     if model_type == "phi3":
         return tfm.TransformerConfig(
             vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
@@ -221,6 +238,14 @@ def _lw_rope(sd, pattern: str, L: int, n_heads: int, head_dim: int,
                                    head_dim, rot_dim) for i in range(L)])
 
 
+def _lb_rope(sd, pattern: str, L: int, n_heads: int, head_dim: int,
+             rot_dim: Optional[int] = None) -> np.ndarray:
+    """Stack rope-unpermuted BIAS rows (qwen2/phi biased rotary layers)."""
+    return _stack([_rope_unpermute_bias(sd[pattern.format(i)], n_heads,
+                                        head_dim, rot_dim)
+                   for i in range(L)])
+
+
 def params_from_hf_llama(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
                          ) -> Dict[str, Any]:
     """LLaMA/Mistral-family HF state_dict → stacked param pytree.
@@ -304,14 +329,12 @@ def params_from_hf_qwen2(state_dict: Dict[str, Any], cfg: tfm.TransformerConfig
     sd = {k: np.asarray(v) for k, v in state_dict.items()}
     L, hd = cfg.num_layers, cfg.head_dim
     if "model.layers.0.self_attn.q_proj.bias" in sd:
-        params["layers"]["attn"]["bq"] = _stack([
-            _rope_unpermute_bias(
-                sd[f"model.layers.{i}.self_attn.q_proj.bias"],
-                cfg.num_heads, hd) for i in range(L)])
-        params["layers"]["attn"]["bk"] = _stack([
-            _rope_unpermute_bias(
-                sd[f"model.layers.{i}.self_attn.k_proj.bias"],
-                cfg.kv_heads, hd) for i in range(L)])
+        params["layers"]["attn"]["bq"] = _lb_rope(
+            sd, "model.layers.{}.self_attn.q_proj.bias", L,
+            cfg.num_heads, hd)
+        params["layers"]["attn"]["bk"] = _lb_rope(
+            sd, "model.layers.{}.self_attn.k_proj.bias", L,
+            cfg.kv_heads, hd)
         params["layers"]["attn"]["bv"] = _stack([
             sd[f"model.layers.{i}.self_attn.v_proj.bias"] for i in range(L)])
     return params
@@ -654,6 +677,86 @@ def params_to_hf_gptj(params: Dict[str, Any], cfg: tfm.TransformerConfig
         out[f"{pre}.mlp.fc_in.bias"] = np.asarray(lp["mlp"]["b_in"][i])
         out[f"{pre}.mlp.fc_out.weight"] = np.asarray(lp["mlp"]["w_out"][i]).T
         out[f"{pre}.mlp.fc_out.bias"] = np.asarray(lp["mlp"]["b_out"][i])
+    return out
+
+
+def params_from_hf_phi(state_dict: Dict[str, Any],
+                       cfg: tfm.TransformerConfig) -> Dict[str, Any]:
+    """Phi-1/2: llama-style naming with biases everywhere, ONE shared
+    layernorm per block (parallel residual — duplicated into ln1/ln2),
+    rotate_half partial rotary, untied lm_head WITH bias."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, hd, nh, nkv = cfg.num_layers, cfg.head_dim, cfg.num_heads, cfg.kv_heads
+    rot = cfg.rot_dim
+    pre = "model.layers.{}"
+    ln_scale = _lnorm(sd, pre + ".input_layernorm.weight", L)
+    ln_bias = _lnorm(sd, pre + ".input_layernorm.bias", L)
+    return {
+        "embed": {"tokens": sd["model.embed_tokens.weight"]},
+        "layers": {
+            "attn": {
+                "wq": _lw_rope(sd, pre + ".self_attn.q_proj.weight",
+                               L, nh, hd, rot),
+                "wk": _lw_rope(sd, pre + ".self_attn.k_proj.weight",
+                               L, nkv, hd, rot),
+                "wv": _lw(sd, pre + ".self_attn.v_proj.weight", L),
+                "wo": _lw(sd, pre + ".self_attn.dense.weight", L),
+                "bq": _lb_rope(sd, pre + ".self_attn.q_proj.bias",
+                               L, nh, hd, rot),
+                "bk": _lb_rope(sd, pre + ".self_attn.k_proj.bias",
+                               L, nkv, hd, rot),
+                "bv": _lnorm(sd, pre + ".self_attn.v_proj.bias", L),
+                "bo": _lnorm(sd, pre + ".self_attn.dense.bias", L),
+            },
+            "ln1": {"scale": ln_scale, "bias": ln_bias},
+            "ln2": {"scale": ln_scale.copy(), "bias": ln_bias.copy()},
+            "mlp": {
+                "w_in": _lw(sd, pre + ".mlp.fc1.weight", L),
+                "w_out": _lw(sd, pre + ".mlp.fc2.weight", L),
+                "b_in": _lnorm(sd, pre + ".mlp.fc1.bias", L),
+                "b_out": _lnorm(sd, pre + ".mlp.fc2.bias", L),
+            },
+        },
+        "final_norm": {"scale": sd["model.final_layernorm.weight"],
+                       "bias": sd["model.final_layernorm.bias"]},
+        "lm_head": {"w": sd["lm_head.weight"].T, "b": sd["lm_head.bias"]},
+    }
+
+
+def params_to_hf_phi(params: Dict[str, Any], cfg: tfm.TransformerConfig
+                     ) -> Dict[str, np.ndarray]:
+    """Phi export (shared-layernorm architecture: ln1 wins)."""
+    lp = params["layers"]
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    rot = cfg.rot_dim
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["tokens"]),
+        "model.final_layernorm.weight": np.asarray(
+            params["final_norm"]["scale"]),
+        "model.final_layernorm.bias": np.asarray(params["final_norm"]["bias"]),
+        "lm_head.weight": np.asarray(params["lm_head"]["w"]).T,
+        "lm_head.bias": np.asarray(params["lm_head"]["b"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}"
+        out[f"{pre}.self_attn.q_proj.weight"] = _rope_permute(
+            np.asarray(lp["attn"]["wq"][i]), nh, hd, rot).T
+        out[f"{pre}.self_attn.q_proj.bias"] = _rope_permute_bias(
+            np.asarray(lp["attn"]["bq"][i]), nh, hd, rot)
+        out[f"{pre}.self_attn.k_proj.weight"] = _rope_permute(
+            np.asarray(lp["attn"]["wk"][i]), nkv, hd, rot).T
+        out[f"{pre}.self_attn.k_proj.bias"] = _rope_permute_bias(
+            np.asarray(lp["attn"]["bk"][i]), nkv, hd, rot)
+        out[f"{pre}.self_attn.v_proj.weight"] = np.asarray(lp["attn"]["wv"][i]).T
+        out[f"{pre}.self_attn.v_proj.bias"] = np.asarray(lp["attn"]["bv"][i])
+        out[f"{pre}.self_attn.dense.weight"] = np.asarray(lp["attn"]["wo"][i]).T
+        out[f"{pre}.self_attn.dense.bias"] = np.asarray(lp["attn"]["bo"][i])
+        out[f"{pre}.input_layernorm.weight"] = np.asarray(lp["ln1"]["scale"][i])
+        out[f"{pre}.input_layernorm.bias"] = np.asarray(lp["ln1"]["bias"][i])
+        out[f"{pre}.mlp.fc1.weight"] = np.asarray(lp["mlp"]["w_in"][i]).T
+        out[f"{pre}.mlp.fc1.bias"] = np.asarray(lp["mlp"]["b_in"][i])
+        out[f"{pre}.mlp.fc2.weight"] = np.asarray(lp["mlp"]["w_out"][i]).T
+        out[f"{pre}.mlp.fc2.bias"] = np.asarray(lp["mlp"]["b_out"][i])
     return out
 
 
@@ -1028,6 +1131,7 @@ ARCH_CONVERTERS: Dict[str, Callable] = {
     "gpt2": params_from_hf_gpt2,
     "bloom": params_from_hf_bloom,
     "gptj": params_from_hf_gptj,
+    "phi": params_from_hf_phi,
 }
 
 
@@ -1045,6 +1149,7 @@ ARCH_EXPORTERS: Dict[str, Callable] = {
     "gpt2": params_to_hf_gpt2,
     "bloom": params_to_hf_bloom,
     "gptj": params_to_hf_gptj,
+    "phi": params_to_hf_phi,
 }
 
 
@@ -1056,6 +1161,8 @@ def params_to_hf(params: Dict[str, Any], cfg: tfm.TransformerConfig,
     consolidated export the HF ecosystem reloads)."""
     if model_type == "bert":
         return params_to_hf_bert(params, cfg)
+    if model_type == "roberta":
+        return params_to_hf_roberta(params, cfg)
     if model_type in ("t5", "mt5"):
         return params_to_hf_t5(params, cfg)
     export = ARCH_EXPORTERS.get(model_type)
@@ -1204,6 +1311,53 @@ def params_to_hf_bert(params: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
         out["cls.predictions.transform.LayerNorm.bias"] = \
             np.asarray(params["mlm"]["norm"]["bias"])
         out["cls.predictions.bias"] = np.asarray(params["mlm"]["decoder_bias"])
+    return out
+
+
+def params_from_hf_roberta(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """RoBERTa → the BERT encoder schema.  RoBERTa's learned positions are
+    stored with a padding offset of 2 (position ids = cumsum + padding_idx);
+    for unpadded inputs that is exactly ``arange + 2``, so the table is
+    sliced from row 2 — same treatment as OPT's offset."""
+    sd = {k.removeprefix("roberta."): np.asarray(v)
+          for k, v in state_dict.items()}
+    renamed = dict(sd)
+    renamed["embeddings.position_embeddings.weight"] = \
+        sd["embeddings.position_embeddings.weight"][2:]
+    # the MLM head lives under lm_head.* instead of cls.predictions.*
+    if "lm_head.dense.weight" in sd:
+        renamed["cls.predictions.transform.dense.weight"] = \
+            sd["lm_head.dense.weight"]
+        renamed["cls.predictions.transform.dense.bias"] = \
+            sd["lm_head.dense.bias"]
+        renamed["cls.predictions.transform.LayerNorm.weight"] = \
+            sd["lm_head.layer_norm.weight"]
+        renamed["cls.predictions.transform.LayerNorm.bias"] = \
+            sd["lm_head.layer_norm.bias"]
+        renamed["cls.predictions.bias"] = sd["lm_head.bias"]
+    return params_from_hf_bert(renamed, cfg)
+
+
+def params_to_hf_roberta(params: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    bert_sd = params_to_hf_bert(params, cfg)
+    out: Dict[str, np.ndarray] = {}
+    head_map = {
+        "cls.predictions.transform.dense.weight": "lm_head.dense.weight",
+        "cls.predictions.transform.dense.bias": "lm_head.dense.bias",
+        "cls.predictions.transform.LayerNorm.weight": "lm_head.layer_norm.weight",
+        "cls.predictions.transform.LayerNorm.bias": "lm_head.layer_norm.bias",
+        "cls.predictions.bias": "lm_head.bias",
+    }
+    for k, v in bert_sd.items():
+        if k in head_map:
+            out[head_map[k]] = v
+        elif k.startswith("bert."):
+            out["roberta." + k[len("bert."):]] = v
+        else:
+            out[k] = v
+    pos = out["roberta.embeddings.position_embeddings.weight"]
+    out["roberta.embeddings.position_embeddings.weight"] = np.concatenate(
+        [np.zeros((2,) + pos.shape[1:], pos.dtype), pos])
     return out
 
 
@@ -1381,7 +1535,7 @@ def params_to_hf_t5(params: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
 
 
 def supported_architectures() -> tuple:
-    return tuple(sorted(ARCH_CONVERTERS)) + ("bert", "t5", "mt5")
+    return tuple(sorted(ARCH_CONVERTERS)) + ("bert", "roberta", "t5", "mt5")
 
 
 def load_hf_model(model_name_or_sd, hf_config=None,
@@ -1401,6 +1555,15 @@ def load_hf_model(model_name_or_sd, hf_config=None,
     if model_type == "bert":  # encoder family: its own config + schema
         ecfg = encoder_config_from_hf(hf_config)
         return ecfg, params_from_hf_bert(sd, ecfg)
+    if model_type == "roberta":
+        import dataclasses as _dc
+
+        ecfg = encoder_config_from_hf(hf_config)
+        # the position table loses its 2-row padding offset in conversion;
+        # the usable length shrinks with it or a max-length input would
+        # index past the sliced table
+        ecfg = _dc.replace(ecfg, max_seq_len=ecfg.max_seq_len - 2)
+        return ecfg, params_from_hf_roberta(sd, ecfg)
     if model_type in ("t5", "mt5"):  # encoder-decoder family
         tcfg = t5_config_from_hf(hf_config)
         return tcfg, params_from_hf_t5(sd, tcfg)

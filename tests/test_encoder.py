@@ -170,3 +170,48 @@ def test_bert_through_trainer(tmp_path, devices):
 
     sd = load_file(str(tmp_path / "export" / "model.safetensors"))
     assert "bert.embeddings.word_embeddings.weight" in sd
+
+
+def test_roberta_mlm_golden(devices):
+    """RoBERTa maps onto the BERT encoder schema (position offset sliced,
+    lm_head.* renamed) — MLM logits exact for unpadded inputs."""
+    from transformers import RobertaConfig, RobertaForMaskedLM
+
+    torch.manual_seed(4)
+    hf = RobertaForMaskedLM(RobertaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=66, type_vocab_size=1,
+        pad_token_id=1)).eval()
+    cfg, params = load_hf_model(hf)
+    toks = np.random.default_rng(6).integers(2, 128, (2, 14)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks.astype(np.int64))).logits.numpy()
+    ours = np.asarray(enc.mlm_logits(params, toks, cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
+
+
+def test_roberta_export_roundtrip(devices):
+    from transformers import RobertaConfig, RobertaForMaskedLM
+
+    torch.manual_seed(4)
+    hf = RobertaForMaskedLM(RobertaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=66, type_vocab_size=1,
+        pad_token_id=1)).eval()
+    cfg, params = load_hf_model(hf)
+    out = params_to_hf(params, cfg, model_type="roberta")
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    for k, v in out.items():
+        assert k in sd, k
+        if k == "roberta.embeddings.position_embeddings.weight":
+            np.testing.assert_array_equal(v[2:], sd[k][2:], err_msg=k)
+            continue
+        np.testing.assert_array_equal(v, sd[k], err_msg=k)
+    _, params2 = load_hf_model(out, hf_config=hf.config)
+    for (p1, l1), (p2, l2) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(params2)[0]):
+        assert p1 == p2
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))

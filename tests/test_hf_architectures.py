@@ -207,3 +207,13 @@ def test_gptj_golden(devices):
     _golden(GPTJConfig(
         vocab_size=128, n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
         n_positions=64, tie_word_embeddings=False))
+
+
+def test_phi_golden(devices):
+    from transformers import PhiConfig
+
+    _golden(PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=64,
+        tie_word_embeddings=False))
