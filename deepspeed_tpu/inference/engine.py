@@ -56,6 +56,8 @@ def forward_cached(params, tokens, cache, start_pos, cfg: tfm.TransformerConfig)
     max_len = cache["k"].shape[2]
 
     x = params["embed"]["tokens"].astype(dt)[tokens]
+    if cfg.embed_scale_by_sqrt_dim:  # gemma normalizer
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, dt)
     if cfg.position == "learned":
         pos_ids = start_pos + jnp.arange(T)
         x = x + params["embed"]["position"].astype(dt)[pos_ids][None]

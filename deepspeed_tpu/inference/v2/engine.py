@@ -112,6 +112,8 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
             context_lens, logits_rows, chunk_start, chunk_len):
         T = token_ids.shape[0]
         x = params["embed"]["tokens"].astype(dt)[token_ids]  # (T, H)
+        if model_cfg.embed_scale_by_sqrt_dim:
+            x = x * jnp.asarray(model_cfg.hidden_size ** 0.5, dt)
         if model_cfg.position == "learned":
             x = x + params["embed"]["position"].astype(dt)[position_ids]
         if model_cfg.embed_norm:
@@ -263,6 +265,8 @@ def _decode_body(params, caches, token_ids, position_ids, block_tables,
     bs = v2.block_size
     S = token_ids.shape[0]
     x = params["embed"]["tokens"].astype(dt)[token_ids]
+    if model_cfg.embed_scale_by_sqrt_dim:
+        x = x * jnp.asarray(model_cfg.hidden_size ** 0.5, dt)
     if model_cfg.position == "learned":
         x = x + params["embed"]["position"].astype(dt)[position_ids]
     if model_cfg.embed_norm:

@@ -119,6 +119,22 @@ def config_from_hf(hf_config) -> tfm.TransformerConfig:
             norm="layernorm", activation="relu", position="learned",
             norm_eps=1e-5,
             tie_embeddings=bool(get("tie_word_embeddings", True)))
+    if model_type == "gemma":
+        # llama key schema; architecture switches: (1+w) rmsnorm, gated
+        # tanh-gelu MLP, sqrt(d) embedding normalizer, explicit head_dim
+        return tfm.TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            num_kv_heads=get("num_key_value_heads"),
+            head_dim_override=get("head_dim"),
+            max_seq_len=get("max_position_embeddings", 8192),
+            rope_theta=get("rope_theta", 10000.0),
+            norm="gemma_rmsnorm", activation="gelu", gated_mlp=True,
+            embed_scale_by_sqrt_dim=True,
+            norm_eps=get("rms_norm_eps", 1e-6),
+            tie_embeddings=bool(get("tie_word_embeddings", True)))
     if model_type == "phi":  # phi-1/phi-1.5/phi-2
         if get("qk_layernorm", False):
             raise ValueError(
@@ -1132,6 +1148,7 @@ ARCH_CONVERTERS: Dict[str, Callable] = {
     "bloom": params_from_hf_bloom,
     "gptj": params_from_hf_gptj,
     "phi": params_from_hf_phi,
+    "gemma": params_from_hf_llama,  # llama key schema (config switches differ)
 }
 
 
@@ -1150,6 +1167,7 @@ ARCH_EXPORTERS: Dict[str, Callable] = {
     "bloom": params_to_hf_bloom,
     "gptj": params_to_hf_gptj,
     "phi": params_to_hf_phi,
+    "gemma": params_to_hf_llama,
 }
 
 

@@ -39,10 +39,18 @@ class TransformerConfig:
     num_layers: int = 4
     num_heads: int = 8
     num_kv_heads: Optional[int] = None  # None => MHA; < num_heads => GQA
+    # explicit per-head width (gemma-7b: 256 != hidden/heads); None derives
+    head_dim_override: Optional[int] = None
     max_seq_len: int = 2048
     # architecture switches
-    norm: str = "rmsnorm"  # rmsnorm (llama) | layernorm (gpt2)
+    # rmsnorm (llama) | layernorm (gpt2) | gemma_rmsnorm ((1+w) scaling)
+    norm: str = "rmsnorm"
     activation: str = "silu"  # silu => SwiGLU; gelu => GELU MLP; relu (opt)
+    # gated two-branch MLP with a non-silu activation (gemma's gated gelu);
+    # silu implies gated regardless
+    gated_mlp: bool = False
+    # multiply embedding output by sqrt(hidden_size) (gemma normalizer)
+    embed_scale_by_sqrt_dim: bool = False
     position: str = "rope"  # rope (llama) | learned (gpt2) | alibi (bloom)
     tie_embeddings: bool = True
     # LayerNorm right after the embedding lookup (bloom
@@ -84,7 +92,18 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.hidden_size // self.num_heads
+        return self.head_dim_override or self.hidden_size // self.num_heads
+
+    @property
+    def is_gated_mlp(self) -> bool:
+        return self.gated_mlp or self.activation == "silu"
+
+    def __post_init__(self):
+        if self.gated_mlp and self.num_experts > 0 and \
+                self.activation != "silu":
+            raise ValueError(
+                "gated_mlp with a non-silu activation is not wired for MoE "
+                "expert blocks (they hardcode silu gating)")
 
     @property
     def rot_dim(self) -> int:
@@ -100,8 +119,9 @@ class TransformerConfig:
     def num_params(self, include_embed: bool = True) -> int:
         h, f, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
         kvh = self.kv_heads * self.head_dim
-        per_layer = h * h + 2 * h * kvh + h * h  # q, k, v, o
-        n_mlp = 3 * h * f if self.activation == "silu" else 2 * h * f
+        qh = self.num_heads * self.head_dim  # != h with head_dim_override
+        per_layer = h * qh + 2 * h * kvh + qh * h  # q, k, v, o
+        n_mlp = 3 * h * f if self.is_gated_mlp else 2 * h * f
         if self.num_experts > 0:
             n_mlp = n_mlp * self.num_experts + h * self.num_experts  # experts + router
         per_layer += n_mlp + 2 * h
@@ -168,6 +188,8 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
     h, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
     hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.kv_heads
     keys = jax.random.split(rng, 16)
+    # gemma's (1+w) norm is identity at w=0; plain rmsnorm at w=1
+    norm_init = jnp.zeros if cfg.norm == "gemma_rmsnorm" else jnp.ones
 
     layer = {
         "attn": {
@@ -176,8 +198,8 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
             "wv": _dense_init(keys[2], (L, h, nkv * hd), h, pd),
             "wo": _dense_init(keys[3], (L, nh * hd, h), nh * hd, pd),
         },
-        "ln1": {"scale": jnp.ones((L, h), pd)},
-        "ln2": {"scale": jnp.ones((L, h), pd)},
+        "ln1": {"scale": norm_init((L, h), pd)},
+        "ln2": {"scale": norm_init((L, h), pd)},
     }
     if cfg.norm == "layernorm":
         layer["ln1"]["bias"] = jnp.zeros((L, h), pd)
@@ -205,14 +227,14 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
             "w_in": _dense_init(keys[5], (L, h, f), h, pd),
             "w_out": _dense_init(keys[7], (L, f, h), f, pd),
         }
-        if cfg.activation == "silu":
+        if cfg.is_gated_mlp:
             mlp["w_gate"] = _dense_init(keys[6], (L, h, f), h, pd)
         layer["mlp"] = mlp
 
     params: Dict[str, Any] = {
         "embed": {"tokens": _dense_init(keys[8], (cfg.vocab_size, h), h, pd)},
         "layers": layer,
-        "final_norm": {"scale": jnp.ones((h,), pd)},
+        "final_norm": {"scale": norm_init((h,), pd)},
     }
     if cfg.norm == "layernorm":
         params["final_norm"]["bias"] = jnp.zeros((h,), pd)
@@ -263,7 +285,7 @@ def param_axes(cfg: TransformerConfig, params: Optional[Dict[str, Any]] = None
         layer["moe"] = moe
     else:
         mlp = {"w_in": ("layers", "embed", "mlp"), "w_out": ("layers", "mlp", "embed")}
-        if cfg.activation == "silu":
+        if cfg.is_gated_mlp:
             mlp["w_gate"] = ("layers", "embed", "mlp")
         layer["mlp"] = mlp
 
@@ -309,6 +331,10 @@ def _norm(x, p, kind: str, eps: float):
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
         y = x * lax.rsqrt(var + eps).astype(x.dtype)
         return y * p["scale"].astype(x.dtype)
+    if kind == "gemma_rmsnorm":  # zero-init weights scale by (1 + w)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        y = x * lax.rsqrt(var + eps).astype(x.dtype)
+        return y * (1.0 + p["scale"].astype(x.dtype))
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True).astype(x.dtype)
     y = (x - mean) * lax.rsqrt(var + eps).astype(x.dtype)
@@ -468,9 +494,11 @@ def apply_activation(x, kind: str):
 
 def _mlp_block(x, p, cfg: TransformerConfig):
     with jax.named_scope("mlp"):
-        if cfg.activation == "silu":
-            return _lin(jax.nn.silu(_lin(x, p, "w_gate", "b_gate"))
-                        * _lin(x, p, "w_in", "b_in"), p, "w_out", "b_out")
+        if cfg.is_gated_mlp:
+            gate = apply_activation(_lin(x, p, "w_gate", "b_gate"),
+                                    cfg.activation)
+            return _lin(gate * _lin(x, p, "w_in", "b_in"), p,
+                        "w_out", "b_out")
         mid = apply_activation(_lin(x, p, "w_in", "b_in"), cfg.activation)
         return _lin(mid, p, "w_out", "b_out")
 
@@ -520,6 +548,8 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
 
     with jax.named_scope("embed"):
         x = params["embed"]["tokens"].astype(dt)[tokens]
+        if cfg.embed_scale_by_sqrt_dim:  # gemma normalizer, hidden-dtype
+            x = x * jnp.asarray(cfg.hidden_size ** 0.5, dt)
         if cfg.position == "learned":
             x = x + params["embed"]["position"].astype(dt)[None, :S]
         if cfg.embed_norm:

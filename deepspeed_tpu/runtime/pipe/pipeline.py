@@ -462,6 +462,8 @@ def pipeline_loss_fn(params, batch, cfg, num_microbatches: int = 2,
     B, S = tokens.shape
 
     x = params["embed"]["tokens"].astype(dt)[tokens]
+    if cfg.embed_scale_by_sqrt_dim:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, dt)
     if cfg.position == "learned":
         x = x + params["embed"]["position"].astype(dt)[None, :S]
 

@@ -217,3 +217,38 @@ def test_phi_golden(devices):
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
         partial_rotary_factor=0.5, max_position_embeddings=64,
         tie_word_embeddings=False))
+
+
+def test_gemma_golden(devices):
+    """Gemma: (1+w) rmsnorm, sqrt(d) embedding normalizer, gated tanh-gelu,
+    and an EXPLICIT head_dim wider than hidden/heads (the gemma-7b shape)."""
+    from transformers import GemmaConfig
+
+    _golden(GemmaConfig(
+        vocab_size=128, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16,  # 4*16=64 != 48: exercises head_dim_override
+        max_position_embeddings=64, tie_word_embeddings=True))
+
+
+def test_gemma_fresh_init_identity_norms(devices):
+    """Native init of a gemma-style config matches the architecture's
+    identity-at-init norm design ((1+w) with w=0) and num_params honors the
+    explicit head_dim."""
+    from deepspeed_tpu.models.hf_integration import config_from_hf
+
+    cfg = config_from_hf({"model_type": "gemma", "vocab_size": 128,
+                          "hidden_size": 48, "intermediate_size": 128,
+                          "num_hidden_layers": 2, "num_attention_heads": 4,
+                          "num_key_value_heads": 2, "head_dim": 16})
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    assert float(np.abs(params["layers"]["ln1"]["scale"]).max()) == 0.0
+    assert float(np.abs(params["final_norm"]["scale"]).max()) == 0.0
+    # q: 48x(4*16), o: (4*16)x48 per layer — not 48x48
+    n = cfg.num_params(include_embed=False)
+    expected_attn = 2 * (48 * 64 + 48 * 2 * 16)  # per layer: q+o, k+v
+    assert n >= 2 * expected_attn  # undercounting h*h would fail this
+    # and the fresh model runs
+    toks = np.zeros((1, 8), np.int32)
+    out = tfm.forward(params, toks, cfg)
+    assert np.isfinite(np.asarray(out)).all()

@@ -201,3 +201,15 @@ def test_phi_export_roundtrip():
         partial_rotary_factor=0.5, max_position_embeddings=64,
         tie_word_embeddings=False)).eval()
     _roundtrip(m)
+
+
+def test_gemma_export_roundtrip():
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    torch.manual_seed(0)
+    m = GemmaForCausalLM(GemmaConfig(
+        vocab_size=128, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64,
+        tie_word_embeddings=True)).eval()
+    _roundtrip(m)
