@@ -55,14 +55,8 @@ def forward_cached(params, tokens, cache, start_pos, cfg: tfm.TransformerConfig)
     B, T = tokens.shape
     max_len = cache["k"].shape[2]
 
-    x = params["embed"]["tokens"].astype(dt)[tokens]
-    if cfg.embed_scale_by_sqrt_dim:  # gemma normalizer
-        x = x * jnp.asarray(cfg.hidden_size ** 0.5, dt)
-    if cfg.position == "learned":
-        pos_ids = start_pos + jnp.arange(T)
-        x = x + params["embed"]["position"].astype(dt)[pos_ids][None]
-    if cfg.embed_norm:  # bloom word_embeddings_layernorm
-        x = tfm._norm(x, params["embed_norm"], "layernorm", cfg.norm_eps)
+    x = tfm.embed_tokens(params, tokens, cfg,
+                         position_ids=start_pos + jnp.arange(T))
     cos_full, sin_full = (None, None)
     if cfg.position == "rope":
         cos_full, sin_full = tfm.rope_table(max_len, cfg.rot_dim, cfg.rope_theta)

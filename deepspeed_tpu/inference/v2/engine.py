@@ -111,14 +111,8 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
     def fwd(params, caches, token_ids, position_ids, seq_index, block_tables,
             context_lens, logits_rows, chunk_start, chunk_len):
         T = token_ids.shape[0]
-        x = params["embed"]["tokens"].astype(dt)[token_ids]  # (T, H)
-        if model_cfg.embed_scale_by_sqrt_dim:
-            x = x * jnp.asarray(model_cfg.hidden_size ** 0.5, dt)
-        if model_cfg.position == "learned":
-            x = x + params["embed"]["position"].astype(dt)[position_ids]
-        if model_cfg.embed_norm:
-            x = tfm._norm(x, params["embed_norm"], "layernorm",
-                          model_cfg.norm_eps)
+        x = tfm.embed_tokens(params, token_ids, model_cfg,
+                             position_ids=position_ids)  # (T, H)
         cos_full, sin_full = (None, None)
         if model_cfg.position == "rope":
             max_len = v2.max_blocks_per_seq * bs
@@ -264,13 +258,8 @@ def _decode_body(params, caches, token_ids, position_ids, block_tables,
     dt = jnp.dtype(v2.dtype)
     bs = v2.block_size
     S = token_ids.shape[0]
-    x = params["embed"]["tokens"].astype(dt)[token_ids]
-    if model_cfg.embed_scale_by_sqrt_dim:
-        x = x * jnp.asarray(model_cfg.hidden_size ** 0.5, dt)
-    if model_cfg.position == "learned":
-        x = x + params["embed"]["position"].astype(dt)[position_ids]
-    if model_cfg.embed_norm:
-        x = tfm._norm(x, params["embed_norm"], "layernorm", model_cfg.norm_eps)
+    x = tfm.embed_tokens(params, token_ids, model_cfg,
+                         position_ids=position_ids)
     cos_full, sin_full = (None, None)
     if model_cfg.position == "rope":
         max_len = v2.max_blocks_per_seq * bs

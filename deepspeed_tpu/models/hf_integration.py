@@ -119,6 +119,17 @@ def config_from_hf(hf_config) -> tfm.TransformerConfig:
             norm="layernorm", activation="relu", position="learned",
             norm_eps=1e-5,
             tie_embeddings=bool(get("tie_word_embeddings", True)))
+    if model_type == "gpt_bigcode":  # starcoder: gpt2 block + MQA
+        h = get("n_embd")
+        return tfm.TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=h,
+            intermediate_size=get("n_inner") or 4 * h,
+            num_layers=get("n_layer"), num_heads=get("n_head"),
+            num_kv_heads=1 if get("multi_query", True) else get("n_head"),
+            max_seq_len=get("n_positions", 2048),
+            norm="layernorm", activation="gelu", position="learned",
+            norm_eps=get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=bool(get("tie_word_embeddings", True)))
     if model_type == "gemma":
         # llama key schema; architecture switches: (1+w) rmsnorm, gated
         # tanh-gelu MLP, sqrt(d) embedding normalizer, explicit head_dim
@@ -696,6 +707,110 @@ def params_to_hf_gptj(params: Dict[str, Any], cfg: tfm.TransformerConfig
     return out
 
 
+def params_from_hf_gpt_bigcode(state_dict: Dict[str, Any],
+                               cfg: tfm.TransformerConfig) -> Dict[str, Any]:
+    """StarCoder/gpt_bigcode: the GPT-2 block with nn.Linear layouts and a
+    fused c_attn of [q (h rows), k (kv·hd), v (kv·hd)] — multi-query (one
+    shared kv head) in the published checkpoints.  Reference policy: the
+    bigcode AutoTP entry."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, h = cfg.num_layers, cfg.hidden_size
+    nh, hd = cfg.num_heads, cfg.head_dim
+    kvd = cfg.kv_heads * cfg.head_dim
+    mq = cfg.kv_heads != cfg.num_heads
+
+    def split_w(i):
+        w = sd[f"h.{i}.attn.c_attn.weight"]
+        if mq:  # (h + 2*kvd, h): [all q rows, k, v]
+            return w[:h].T, w[h:h + kvd].T, w[h + kvd:].T
+        wg = w.reshape(nh, 3, hd, h)  # non-MQ: per-head [q,k,v] interleave
+        return (wg[:, 0].reshape(nh * hd, h).T,
+                wg[:, 1].reshape(nh * hd, h).T,
+                wg[:, 2].reshape(nh * hd, h).T)
+
+    def split_b(i):
+        b = sd[f"h.{i}.attn.c_attn.bias"]
+        if mq:
+            return b[:h], b[h:h + kvd], b[h + kvd:]
+        bg = b.reshape(nh, 3, hd)
+        return (bg[:, 0].reshape(nh * hd), bg[:, 1].reshape(nh * hd),
+                bg[:, 2].reshape(nh * hd))
+
+    qs, ks, vs = zip(*(split_w(i) for i in range(L)))
+    bqs, bks, bvs = zip(*(split_b(i) for i in range(L)))
+    lb = lambda pattern: _lnorm(sd, pattern, L)  # noqa: E731
+    params: Dict[str, Any] = {
+        "embed": {"tokens": sd["wte.weight"], "position": sd["wpe.weight"]},
+        "layers": {
+            "attn": {
+                "wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
+                "wo": _lw(sd, "h.{}.attn.c_proj.weight", L),
+                "bq": _stack(bqs), "bk": _stack(bks), "bv": _stack(bvs),
+                "bo": lb("h.{}.attn.c_proj.bias"),
+            },
+            "ln1": {"scale": lb("h.{}.ln_1.weight"),
+                    "bias": lb("h.{}.ln_1.bias")},
+            "ln2": {"scale": lb("h.{}.ln_2.weight"),
+                    "bias": lb("h.{}.ln_2.bias")},
+            "mlp": {
+                "w_in": _lw(sd, "h.{}.mlp.c_fc.weight", L),
+                "w_out": _lw(sd, "h.{}.mlp.c_proj.weight", L),
+                "b_in": lb("h.{}.mlp.c_fc.bias"),
+                "b_out": lb("h.{}.mlp.c_proj.bias"),
+            },
+        },
+        "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+    if not cfg.tie_embeddings and "lm_head.weight" in sd:
+        params["lm_head"] = {"w": sd["lm_head.weight"].T}
+    return params
+
+
+def params_to_hf_gpt_bigcode(params: Dict[str, Any],
+                             cfg: tfm.TransformerConfig
+                             ) -> Dict[str, np.ndarray]:
+    lp = params["layers"]
+    out: Dict[str, np.ndarray] = {
+        "transformer.wte.weight": np.asarray(params["embed"]["tokens"]),
+        "transformer.wpe.weight": np.asarray(params["embed"]["position"]),
+        "transformer.ln_f.weight": np.asarray(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": np.asarray(params["final_norm"]["bias"]),
+    }
+    nh, hd, h = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    mq = cfg.kv_heads != cfg.num_heads
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}"
+        q = np.asarray(lp["attn"]["wq"][i]).T
+        k = np.asarray(lp["attn"]["wk"][i]).T
+        v = np.asarray(lp["attn"]["wv"][i]).T
+        bq = np.asarray(lp["attn"]["bq"][i])
+        bk = np.asarray(lp["attn"]["bk"][i])
+        bv = np.asarray(lp["attn"]["bv"][i])
+        if mq:
+            out[f"{pre}.attn.c_attn.weight"] = np.concatenate([q, k, v])
+            out[f"{pre}.attn.c_attn.bias"] = np.concatenate([bq, bk, bv])
+        else:  # re-interleave per head
+            wg = np.stack([q.reshape(nh, hd, h), k.reshape(nh, hd, h),
+                           v.reshape(nh, hd, h)], axis=1)
+            out[f"{pre}.attn.c_attn.weight"] = wg.reshape(3 * nh * hd, h)
+            bg = np.stack([bq.reshape(nh, hd), bk.reshape(nh, hd),
+                           bv.reshape(nh, hd)], axis=1)
+            out[f"{pre}.attn.c_attn.bias"] = bg.reshape(3 * nh * hd)
+        out[f"{pre}.attn.c_proj.weight"] = np.asarray(lp["attn"]["wo"][i]).T
+        out[f"{pre}.attn.c_proj.bias"] = np.asarray(lp["attn"]["bo"][i])
+        out[f"{pre}.ln_1.weight"] = np.asarray(lp["ln1"]["scale"][i])
+        out[f"{pre}.ln_1.bias"] = np.asarray(lp["ln1"]["bias"][i])
+        out[f"{pre}.ln_2.weight"] = np.asarray(lp["ln2"]["scale"][i])
+        out[f"{pre}.ln_2.bias"] = np.asarray(lp["ln2"]["bias"][i])
+        out[f"{pre}.mlp.c_fc.weight"] = np.asarray(lp["mlp"]["w_in"][i]).T
+        out[f"{pre}.mlp.c_fc.bias"] = np.asarray(lp["mlp"]["b_in"][i])
+        out[f"{pre}.mlp.c_proj.weight"] = np.asarray(lp["mlp"]["w_out"][i]).T
+        out[f"{pre}.mlp.c_proj.bias"] = np.asarray(lp["mlp"]["b_out"][i])
+    if not cfg.tie_embeddings and "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]["w"]).T
+    return out
+
+
 def params_from_hf_phi(state_dict: Dict[str, Any],
                        cfg: tfm.TransformerConfig) -> Dict[str, Any]:
     """Phi-1/2: llama-style naming with biases everywhere, ONE shared
@@ -1149,6 +1264,7 @@ ARCH_CONVERTERS: Dict[str, Callable] = {
     "gptj": params_from_hf_gptj,
     "phi": params_from_hf_phi,
     "gemma": params_from_hf_llama,  # llama key schema (config switches differ)
+    "gpt_bigcode": params_from_hf_gpt_bigcode,
 }
 
 
@@ -1168,6 +1284,7 @@ ARCH_EXPORTERS: Dict[str, Callable] = {
     "gptj": params_to_hf_gptj,
     "phi": params_to_hf_phi,
     "gemma": params_to_hf_llama,
+    "gpt_bigcode": params_to_hf_gpt_bigcode,
 }
 
 

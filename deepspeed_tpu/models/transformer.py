@@ -446,6 +446,26 @@ def resolve_attention(impl: str) -> AttentionFn:
     raise ValueError(f"unknown attn_impl {impl!r}")
 
 
+def embed_tokens(params, token_ids, cfg: TransformerConfig,
+                 position_ids=None):
+    """Shared embedding preamble — token lookup, gemma sqrt(d) normalizer,
+    learned positions, bloom embedding layernorm.  EVERY forward path
+    (training, pipeline, inference v1/v2) starts here, so an embedding-level
+    architecture switch cannot silently diverge between engines.
+    ``position_ids`` defaults to arange over the trailing token axis."""
+    dt = jnp.dtype(cfg.dtype)
+    x = params["embed"]["tokens"].astype(dt)[token_ids]
+    if cfg.embed_scale_by_sqrt_dim:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, dt)
+    if cfg.position == "learned":
+        if position_ids is None:
+            position_ids = jnp.arange(token_ids.shape[-1])
+        x = x + params["embed"]["position"].astype(dt)[position_ids]
+    if cfg.embed_norm:
+        x = _norm(x, params["embed_norm"], "layernorm", cfg.norm_eps)
+    return x
+
+
 def _lin(x, p, w_key, b_key):
     w = p[w_key]
     if isinstance(w, QuantizedWeight):  # W8A16/W4A16 in-kernel dequant
@@ -547,13 +567,7 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
     B, S = tokens.shape
 
     with jax.named_scope("embed"):
-        x = params["embed"]["tokens"].astype(dt)[tokens]
-        if cfg.embed_scale_by_sqrt_dim:  # gemma normalizer, hidden-dtype
-            x = x * jnp.asarray(cfg.hidden_size ** 0.5, dt)
-        if cfg.position == "learned":
-            x = x + params["embed"]["position"].astype(dt)[None, :S]
-        if cfg.embed_norm:
-            x = _norm(x, params["embed_norm"], "layernorm", cfg.norm_eps)
+        x = embed_tokens(params, tokens, cfg)
     cos, sin = (None, None)
     if cfg.position == "rope":
         cos, sin = rope_table(S, cfg.rot_dim, cfg.rope_theta)

@@ -461,11 +461,7 @@ def pipeline_loss_fn(params, batch, cfg, num_microbatches: int = 2,
     tokens = batch["input_ids"]
     B, S = tokens.shape
 
-    x = params["embed"]["tokens"].astype(dt)[tokens]
-    if cfg.embed_scale_by_sqrt_dim:
-        x = x * jnp.asarray(cfg.hidden_size ** 0.5, dt)
-    if cfg.position == "learned":
-        x = x + params["embed"]["position"].astype(dt)[None, :S]
+    x = tfm.embed_tokens(params, tokens, cfg)
 
     if schedule == "1f1b" and get_topology().size("pp") > 1:
         topo = get_topology()

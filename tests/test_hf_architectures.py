@@ -252,3 +252,14 @@ def test_gemma_fresh_init_identity_norms(devices):
     toks = np.zeros((1, 8), np.int32)
     out = tfm.forward(params, toks, cfg)
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("mq", [True, False])
+def test_gpt_bigcode_golden(devices, mq):
+    """StarCoder block: fused [q, kv] c_attn with multi-query (1 shared kv
+    head) and the multi-head variant."""
+    from transformers import GPTBigCodeConfig
+
+    _golden(GPTBigCodeConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        multi_query=mq))

@@ -213,3 +213,14 @@ def test_gemma_export_roundtrip():
         head_dim=16, max_position_embeddings=64,
         tie_word_embeddings=True)).eval()
     _roundtrip(m)
+
+
+@pytest.mark.parametrize("mq", [True, False])
+def test_gpt_bigcode_export_roundtrip(mq):
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM
+
+    torch.manual_seed(0)
+    m = GPTBigCodeForCausalLM(GPTBigCodeConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        multi_query=mq)).eval()
+    _roundtrip(m)
