@@ -119,10 +119,20 @@ def _write_evidence_pack(telemetry: dict) -> None:
         with open(os.path.join(_REPO, "BENCH_EVIDENCE.json"), "w") as f:
             json.dump(evidence, f, indent=1)
         ms = evidence.get("multichip_step", {})
+        gr = evidence.get("grad_reduction", {})
         telemetry["evidence"] = {
             "file": "BENCH_EVIDENCE.json",
             "collectives": ms.get("collectives"),
             "hlo_fusions": evidence.get("fusion", {}).get("hlo_fusions"),
+            # coalescing proof: per-stage gradient all-reduce counts and the
+            # per-leaf baseline they replace (runtime/coalesce.py)
+            "grad_all_reduces": {
+                k: v.get("collectives", {}).get("all-reduce")
+                for k, v in gr.items() if isinstance(v, dict)},
+            "grad_buckets": {
+                k: (v.get("bucket_plan") or {}).get("num_buckets")
+                for k, v in gr.items()
+                if isinstance(v, dict) and v.get("bucket_plan")},
         }
     except Exception as e:  # noqa: BLE001 — evidence is best-effort
         telemetry["evidence"] = {"error": f"{type(e).__name__}: {e}"}

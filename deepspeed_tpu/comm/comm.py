@@ -250,12 +250,13 @@ def axis_index(axis_name: AxisName):
 
 
 def axis_size(axis_name: AxisName) -> int:
-    import jax.lax as lax
     import math
 
+    from ..compat import axis_size as _axis_size
+
     if isinstance(axis_name, str):
-        return lax.axis_size(axis_name)
-    return math.prod(lax.axis_size(a) for a in axis_name)
+        return _axis_size(axis_name)
+    return math.prod(_axis_size(a) for a in axis_name)
 
 
 # ---------------------------------------------------------------------------

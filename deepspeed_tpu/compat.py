@@ -1,0 +1,61 @@
+"""JAX version compatibility shims.
+
+The codebase targets the modern ``jax.shard_map`` API (top-level export,
+``check_vma=`` keyword).  Older JAX releases (< 0.6) only ship
+``jax.experimental.shard_map.shard_map`` whose replication-check keyword is
+spelled ``check_rep``.  Every module imports ``shard_map`` from here instead
+of from ``jax`` so one shim covers the whole tree (tests included).
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # jax >= 0.6: top-level export with the check_vma keyword
+    from jax import shard_map as _native_shard_map  # type: ignore[attr-defined]
+
+    _IMPL, _NATIVE = _native_shard_map, True
+except ImportError:  # older jax: experimental module, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    _IMPL, _NATIVE = _experimental_shard_map, False
+
+
+@functools.wraps(_IMPL)
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, *, check_vma=None,
+              check_rep=None, **kwargs):
+    """``jax.shard_map`` with the ``check_vma``/``check_rep`` spelling of the
+    replication check accepted interchangeably on every JAX version."""
+    flag = check_vma if check_vma is not None else check_rep
+    if _NATIVE:
+        if flag is not None:
+            kwargs["check_vma"] = flag
+        return _IMPL(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **kwargs)
+    if flag is not None:
+        kwargs["check_rep"] = flag
+    return _IMPL(f, mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis, inside ``shard_map``/``pmap``.
+
+    ``jax.lax.axis_size`` only exists on newer JAX; older releases expose the
+    same number through ``jax.core.axis_frame`` (which returns the size as a
+    plain int on 0.4.x).  Always a Python int, so it is safe in ``range()``
+    and permutation lists."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return int(getattr(frame, "size", frame))
+
+
+def tpu_compiler_params(**kwargs):
+    """Pallas TPU compiler params across the ``CompilerParams`` (new) /
+    ``TPUCompilerParams`` (≤ 0.4.x) rename; same fields either way."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...compat import tpu_compiler_params
+
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 NUM_LANES = 128
 NUM_SUBLANES = 8
@@ -216,7 +218,7 @@ def _fwd_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
 def _pallas_call(kernel, grid, in_specs, out_specs, out_shape, scratch_shapes,
                  mask_tab, inputs):
     """Dispatch with or without the scalar-prefetched block-mask table."""
-    params = pltpu.CompilerParams(
+    params = tpu_compiler_params(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
     if mask_tab is not None:
         grid_spec = pltpu.PrefetchScalarGridSpec(

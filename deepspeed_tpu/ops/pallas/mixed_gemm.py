@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...compat import tpu_compiler_params
 from ..quantizer import (minifloat_decode, minifloat_encode, minifloat_max,
                          pack_fp6, pack_int4, unpack_fp6, unpack_int4)
 from .flash_attention import _interpret, aligned_divisor
@@ -175,7 +176,7 @@ def _gemm_pallas(x2: jax.Array, qw: QuantizedWeight, tm: int, tn: int):
         out_specs=pl.BlockSpec((tm, tn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), x2.dtype),
         scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(x2, qw.codes, qw.scales[:, None, :])
@@ -297,7 +298,7 @@ def int8_gemm(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
         out_specs=pl.BlockSpec((tm, tn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M + pad_m, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(codes, scales.T[:, :, None], qw.codes, qw.scales[:, None, :])

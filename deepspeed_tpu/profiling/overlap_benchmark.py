@@ -29,7 +29,9 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from ..compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.topology import get_topology

@@ -1,0 +1,332 @@
+"""Bucketed gradient coalescing — the IPG-bucket role on TPU.
+
+The reference reduces gradients through *independent partition gradient*
+buckets (``stage_1_and_2.py reduce_independent_p_g_buckets_and_remove_grads``,
+``allreduce_bucket_size`` / ``reduce_bucket_size``): small per-parameter
+tensors are copied into a few large contiguous buffers and reduced with ONE
+collective per buffer, amortizing collective launch latency and per-message
+overhead.  Without it a many-leaf model pays one all-reduce per parameter
+leaf — the seed's compiled train step emitted 31.
+
+This module is the same lever expressed functionally, *inside* the jitted
+step: a host-side :class:`BucketPlan` (pure Python, built once per engine
+from static shapes) assigns every gradient leaf to a per-dtype bucket capped
+at ``reduce_bucket_size`` elements; trace-time helpers flatten the leaves
+into each bucket, hand the bucket to one fused collective, and scatter the
+result back into the pytree.  Three layouts cover the engine's reduction
+paths:
+
+* **flat** buckets → one ``psum`` each (plain DP / ZeRO-0/1, and the exact
+  remainder of the compressed paths);
+* **shard-major** buckets → one ``psum_scatter`` each (ZeRO-2): the bucket is
+  laid out so shard *k* holds the *k*-th slice of every member leaf, making
+  the fused reduce-scatter output land directly in the optimizer-state
+  sharding — no re-layout copy;
+* whole-bucket payloads for the wire-compression schemes (1-bit
+  ``ops/onebit.py``, qgZ ``ops/quantizer.compressed_all_reduce``): fewer
+  compression round trips, and sub-block leaves share blocks instead of each
+  padding one out.
+
+Everything here is collective-free except the one call per bucket, so the
+compiled HLO's collective census equals ``len(plan.buckets)`` (+1 for the
+coalesced scalar metrics) — asserted by ``profiling/compile_evidence.py``
+and ``tests/test_coalesce_hlo.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_BUCKET_NUMEL = 500_000_000  # reference reduce_bucket_size default
+
+
+# ---------------------------------------------------------------------------
+# the plan (host-side, static)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Slot:
+    """One leaf's place inside a bucket."""
+
+    leaf: int                 # index into the flattened-leaves list
+    offset: int               # element offset within the bucket
+    size: int                 # element count
+    shape: Tuple[int, ...]
+    shard_dim: Optional[int] = None  # set only in shard-major buckets
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    dtype: Any                # np.dtype of every member leaf
+    slots: Tuple[Slot, ...]
+    numel: int                # sum of member sizes (no inter-leaf padding)
+    scatter: bool = False     # shard-major reduce-scatter bucket?
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.numel) * np.dtype(self.dtype).itemsize
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    num_leaves: int
+    buckets: Tuple[Bucket, ...]
+    world: int                # shard count scatter buckets divide over
+
+    def stats(self) -> Dict[str, Any]:
+        """Auditable summary (bench / compile-evidence surface)."""
+        return {
+            "num_buckets": len(self.buckets),
+            "num_leaves": self.num_leaves,
+            "bucketed_leaves": int(sum(len(b.slots) for b in self.buckets)),
+            "scatter_buckets": int(sum(1 for b in self.buckets if b.scatter)),
+            "bucket_numels": [int(b.numel) for b in self.buckets],
+            "bucket_dtypes": [np.dtype(b.dtype).name for b in self.buckets],
+            "total_elements": int(sum(b.numel for b in self.buckets)),
+        }
+
+
+def _dtype_of(leaf) -> np.dtype:
+    return np.dtype(getattr(leaf, "dtype", np.float32))
+
+
+def plan_buckets(tree: Any, bucket_numel: int, *, world: int = 1,
+                 shard_dims: Optional[Sequence[Optional[int]]] = None,
+                 ) -> BucketPlan:
+    """Assign every leaf of ``tree`` (arrays or ShapeDtypeStructs) to a
+    bucket of at most ``bucket_numel`` elements, grouped by dtype.
+
+    ``shard_dims`` (parallel to the flattened leaves) marks leaves whose
+    reduction should land sharded: leaf *i* with ``shard_dims[i] = d`` joins
+    a shard-major *scatter* bucket splitting dim ``d`` into ``world`` equal
+    parts (the caller guarantees divisibility — here it is asserted).
+    ``None`` entries (and all leaves when ``shard_dims`` is None) go to flat
+    psum buckets.  Leaf order within a dtype group is preserved, so the
+    layout is deterministic across processes.
+
+    A single leaf larger than ``bucket_numel`` still gets (its own) bucket —
+    the cap bounds coalescing, it never splits a tensor (reference
+    semantics: a bucket flushes when the NEXT tensor would overflow it).
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if shard_dims is None:
+        shard_dims = [None] * len(leaves)
+    if len(shard_dims) != len(leaves):
+        raise ValueError(
+            f"shard_dims has {len(shard_dims)} entries for {len(leaves)} "
+            "leaves")
+    bucket_numel = int(bucket_numel)
+    if bucket_numel <= 0:
+        raise ValueError(f"bucket_numel must be positive, got {bucket_numel}")
+
+    # (dtype, scatter?) → open bucket accumulator
+    open_buckets: Dict[Tuple[str, bool], List[Slot]] = {}
+    open_sizes: Dict[Tuple[str, bool], int] = {}
+    done: List[Bucket] = []
+
+    def flush(key):
+        slots = open_buckets.pop(key, None)
+        if slots:
+            done.append(Bucket(dtype=np.dtype(key[0]), slots=tuple(slots),
+                               numel=open_sizes.pop(key), scatter=key[1]))
+
+    for i, leaf in enumerate(leaves):
+        shape = tuple(getattr(leaf, "shape", ()))
+        size = int(np.prod(shape)) if shape else 1
+        d = shard_dims[i]
+        scatter = d is not None
+        if scatter:
+            if not shape or shape[d] % world:
+                raise ValueError(
+                    f"leaf {i} shape {shape} dim {d} not divisible by "
+                    f"world={world}")
+        key = (np.dtype(_dtype_of(leaf)).name, scatter)
+        if key in open_buckets and open_sizes[key] + size > bucket_numel:
+            flush(key)
+        slots = open_buckets.setdefault(key, [])
+        off = open_sizes.get(key, 0)
+        slots.append(Slot(leaf=i, offset=off, size=size, shape=shape,
+                          shard_dim=d if scatter else None))
+        open_sizes[key] = off + size
+    for key in list(open_buckets):
+        flush(key)
+    return BucketPlan(num_leaves=len(leaves), buckets=tuple(done),
+                      world=int(world))
+
+
+# ---------------------------------------------------------------------------
+# trace-time flatten / unflatten
+# ---------------------------------------------------------------------------
+
+
+def flatten_bucket(bucket: Bucket, leaves: Sequence[jax.Array]) -> jax.Array:
+    """Concatenate the bucket's member leaves into one flat 1-D buffer."""
+    parts = [leaves[s.leaf].reshape(-1) for s in bucket.slots]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def unflatten_bucket(bucket: Bucket, flat: jax.Array
+                     ) -> List[Tuple[int, jax.Array]]:
+    """Inverse of :func:`flatten_bucket` → [(leaf_index, leaf_value)]."""
+    return [(s.leaf, flat[s.offset:s.offset + s.size].reshape(s.shape))
+            for s in bucket.slots]
+
+
+def flatten_bucket_shard_major(bucket: Bucket, leaves: Sequence[jax.Array],
+                               world: int) -> jax.Array:
+    """Shard-major layout: the flat buffer is ``world`` contiguous segments;
+    segment *k* concatenates the *k*-th slice (along each leaf's shard_dim)
+    of every member leaf.  ``psum_scatter(..., tiled=True)`` then hands shard
+    *k* exactly its leaves' local shards, contiguous and copy-free."""
+    rows = []
+    for s in bucket.slots:
+        x, d = leaves[s.leaf], s.shard_dim
+        shp = x.shape
+        x = x.reshape(shp[:d] + (world, shp[d] // world) + shp[d + 1:])
+        rows.append(jnp.moveaxis(x, d, 0).reshape(world, -1))
+    row = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=1)
+    return row.reshape(-1)
+
+
+def unflatten_bucket_shard(bucket: Bucket, shard: jax.Array, world: int
+                           ) -> List[Tuple[int, jax.Array]]:
+    """Split one device's scattered bucket shard (numel/world elements) back
+    into the member leaves' LOCAL shard arrays (shard_dim divided by world)."""
+    out = []
+    off = 0
+    for s in bucket.slots:
+        n = s.size // world
+        d = s.shard_dim
+        local_shape = s.shape[:d] + (s.shape[d] // world,) + s.shape[d + 1:]
+        out.append((s.leaf, shard[off:off + n].reshape(local_shape)))
+        off += n
+    return out
+
+
+def reduce_bucketed(plan: BucketPlan, tree: Any,
+                    reduce_flat: Callable[[Bucket, jax.Array], jax.Array],
+                    reduce_scatter: Optional[
+                        Callable[[Bucket, jax.Array], jax.Array]] = None,
+                    ) -> Any:
+    """Reduce every leaf of ``tree`` through its bucket.
+
+    ``reduce_flat(bucket, flat)`` must return the reduced buffer at the SAME
+    length (psum, compressed all-reduce, ...).  ``reduce_scatter(bucket,
+    flat)`` receives a shard-major buffer of ``numel`` elements and must
+    return this device's ``numel / plan.world`` chunk; its leaves come back
+    as LOCAL shards (callers running under ``shard_map`` give those leaves
+    sharded out_specs).  Runs inside jit/shard_map — no collective happens
+    here except the ones the callbacks issue, one per bucket.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out: List[Any] = list(leaves)
+    for bucket in plan.buckets:
+        if bucket.scatter:
+            if reduce_scatter is None:
+                raise ValueError("plan has scatter buckets but no "
+                                 "reduce_scatter callback")
+            flat = flatten_bucket_shard_major(bucket, leaves, plan.world)
+            shard = reduce_scatter(bucket, flat)
+            pairs = unflatten_bucket_shard(bucket, shard, plan.world)
+        else:
+            flat = flatten_bucket(bucket, leaves)
+            pairs = unflatten_bucket(bucket, reduce_flat(bucket, flat))
+        for i, v in pairs:
+            out[i] = v
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# coalesced scalar reductions (metrics)
+# ---------------------------------------------------------------------------
+
+
+def psum_scalars(tree: Any, axis_names, scale: float = 1.0,
+                 extra: Optional[jax.Array] = None) -> Any:
+    """psum a pytree of scalars as ONE stacked vector collective instead of
+    one per leaf (the metrics dict otherwise re-explodes the op count the
+    gradient buckets just removed).
+
+    ``extra`` rides the same collective WITHOUT the ``scale`` factor (the
+    engine uses it for the gradient sum-of-squares, whose per-shard weighting
+    the caller already applied) — when given, returns ``(tree, extra_sum)``.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    cols = [jnp.asarray(l, jnp.float32).reshape(()) * scale for l in leaves]
+    if extra is not None:
+        cols.append(jnp.asarray(extra, jnp.float32).reshape(()))
+    if not cols:
+        return tree
+    summed = jax.lax.psum(jnp.stack(cols), axis_names)
+    out = jax.tree_util.tree_unflatten(
+        treedef, [summed[i] for i in range(len(leaves))])
+    return out if extra is None else (out, summed[len(leaves)])
+
+
+# ---------------------------------------------------------------------------
+# config resolution
+# ---------------------------------------------------------------------------
+
+
+def resolve_bucket_numel(zero_cfg) -> int:
+    """Effective bucket capacity (elements, reference units) from the zero
+    config: ``allreduce_bucket_size`` (the stage-0/1 spelling) wins when set,
+    else ``reduce_bucket_size``; ``"auto"`` → the reference default; 0
+    disables coalescing (per-leaf legacy path)."""
+    from .config_utils import is_auto
+
+    for key in ("allreduce_bucket_size", "reduce_bucket_size"):
+        v = getattr(zero_cfg, key, None)
+        if v is None or is_auto(v):
+            continue
+        return int(v)
+    return DEFAULT_BUCKET_NUMEL
+
+
+def shard_dims_for(tree: Any, shardings: Any, dp_axes: Sequence[str],
+                   axis_sizes: Dict[str, int]) -> List[Optional[int]]:
+    """Which dim of each leaf (if any) is sharded over exactly the data-
+    parallel world under ``shardings`` — the leaves whose fused reduction can
+    be a shard-major reduce-scatter.  Leaves replicated (or sharded some
+    other way) return None and take the flat psum bucket.
+
+    The matching is strict up to size-1 axes: after dropping axes of size 1
+    (they do not move data), the dim's mesh axes must equal the size>1
+    subset of ``dp_axes`` in the same order, so ``psum_scatter`` over
+    ``dp_axes`` linearizes shards exactly as the GSPMD sharding does.  Axes
+    missing from ``axis_sizes`` are treated as size 1 — callers must only
+    pass shardings whose other mesh axes are trivial (the engine gates
+    coalescing on tp/sp/ep/pp == 1)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    shard_leaves = jax.tree_util.tree_leaves(shardings)
+    effective = tuple(a for a in dp_axes if axis_sizes.get(a, 1) > 1)
+    world = int(np.prod([axis_sizes[a] for a in effective])) if effective else 1
+    dims: List[Optional[int]] = []
+    for leaf, sh in zip(leaves, shard_leaves):
+        spec = tuple(getattr(sh, "spec", ()) or ())
+        found = None
+        ok = bool(effective)
+        for d, entry in enumerate(spec):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            axes = tuple(a for a in axes if axis_sizes.get(a, 1) > 1)
+            if not axes:
+                continue  # only size-1 axes: effectively unsharded
+            if axes != effective or found is not None:
+                ok = False  # not the dp world, or sharded twice
+                break
+            found = d
+        shape = tuple(getattr(leaf, "shape", ()))
+        if (not ok or found is None or not shape
+                or shape[found] % world):
+            dims.append(None)
+        else:
+            dims.append(found)
+    return dims

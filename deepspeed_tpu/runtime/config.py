@@ -138,7 +138,15 @@ class ZeroConfig(DSConfigModel):
     stage: int = 0
     contiguous_gradients: bool = True
     reduce_scatter: bool = True
+    # IPG-bucket capacity in ELEMENTS (reference units): gradient leaves are
+    # coalesced into contiguous per-dtype buckets of at most this many
+    # elements and reduced with ONE collective per bucket
+    # (runtime/coalesce.py).  "auto" → the reference default (5e8); 0
+    # disables coalescing (legacy per-leaf reduction).
     reduce_bucket_size: Union[int, str] = 500_000_000
+    # stage-0/1 spelling of the same knob (reference allreduce_bucket_size);
+    # when set (non-None, non-"auto") it wins over reduce_bucket_size.
+    allreduce_bucket_size: Optional[Union[int, str]] = None
     allgather_partitions: bool = True
     allgather_bucket_size: Union[int, str] = 500_000_000
     overlap_comm: Optional[bool] = None

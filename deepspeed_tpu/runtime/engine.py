@@ -294,6 +294,51 @@ class TrainingEngine:
                         "parallelism (model-internal collectives cannot nest "
                         "inside the manual dp reduction)")
 
+        # ---- gradient coalescing (IPG buckets; coalesce.py) -----------
+        # Fuse the per-leaf gradient reductions into a few contiguous
+        # per-dtype buckets (reference reduce_independent_p_g_buckets /
+        # allreduce_bucket_size).  Eligible whenever the DP reduction can be
+        # made explicit: params replicated over the dp axes (stage ≤ 2), no
+        # model-internal collectives (tp/sp/ep/pp == 1), no offload (the
+        # offloaded grad step reduces on a different schedule).  Stage 3
+        # keeps the emergent GSPMD schedule: its reductions live inside the
+        # scanned backward, interleaved with the fsdp param all-gathers.
+        from .coalesce import (plan_buckets, resolve_bucket_numel,
+                               shard_dims_for)
+
+        self.reduce_bucket_numel = resolve_bucket_numel(
+            config.zero_optimization)
+        explicit_dp_ok = (
+            stage <= 2 and not self.offload_enabled
+            and not self.param_offload_enabled
+            and topo.dp_world_size > 1  # nothing to reduce across on 1 rank
+            and all(topo.size(ax) == 1 for ax in ("tp", "sp", "ep", "pp")))
+        self._bucket_plan = None   # exact path (scatter buckets at stage ≥2)
+        self._wire_plan = None     # compressed paths (flat buckets only)
+        if self.reduce_bucket_numel > 0 and explicit_dp_ok:
+            grad_shapes = jax.tree.map(
+                lambda p: jax.ShapeDtypeStruct(tuple(p.shape), jnp.float32),
+                model.params)
+            shard_dims = None
+            if stage >= 2:
+                # ZeRO-2: leaves whose optimizer sharding splits a dim over
+                # the dp world ride shard-major buckets → fused reduce-
+                # scatter lands directly in the optimizer-state sharding
+                shard_dims = shard_dims_for(
+                    grad_shapes, self.opt_param_shardings, ("dp", "fsdp"),
+                    {ax: topo.size(ax) for ax in ("dp", "fsdp")})
+            self._bucket_plan = plan_buckets(
+                grad_shapes, self.reduce_bucket_numel,
+                world=topo.dp_world_size, shard_dims=shard_dims)
+            self._wire_plan = plan_buckets(grad_shapes,
+                                           self.reduce_bucket_numel)
+            st = self._bucket_plan.stats()
+            log_dist(
+                f"gradient coalescing: {st['num_leaves']} leaves -> "
+                f"{st['num_buckets']} bucket(s) "
+                f"({st['scatter_buckets']} reduce-scatter), cap="
+                f"{self.reduce_bucket_numel} elements")
+
         # ---- state init (sharded at construction) ---------------------
         self.opt_shardings = None  # set inside _init_state
         self.state = self._init_state()
@@ -457,28 +502,40 @@ class TrainingEngine:
         return int(self.config.gradient_compression.freeze_step)
 
     def _init_onebit(self) -> None:
-        """Error-feedback residuals (worker + server, per compressed leaf)
-        and the compressed-reduction step function.  Residuals are (W, len)
-        fp32 sharded over the dp axes — each shard owns its own feedback."""
+        """Error-feedback residuals (worker + server) and the compressed-
+        reduction step function.  Residuals are (W, len) fp32 sharded over
+        the dp axes — each shard owns its own feedback.  With coalescing the
+        unit of compression is the BUCKET, so residuals are a tuple aligned
+        with ``_wire_plan.buckets`` (0-length for buckets small enough to
+        psum exactly); without it they mirror the param tree per leaf."""
         from jax.sharding import NamedSharding
         from ..ops.onebit import residual_shapes
 
         W = int(self.topo.dp_world_size)
         sh = NamedSharding(self.topo.mesh, P(("dp", "fsdp")))
+        plan = self._wire_plan
 
-        def length(leaf, slot):
-            if leaf.size >= self._ONEBIT_MIN_NUMEL:
+        def length(numel, slot):
+            if numel >= self._ONEBIT_MIN_NUMEL:
                 # worker residual (slot 0): each shard's FULL padded vector;
                 # server residual (slot 1): each shard's own chunk
-                return residual_shapes(leaf.size, W, self._ONEBIT_BLOCK)[slot]
+                return residual_shapes(numel, W, self._ONEBIT_BLOCK)[slot]
             return 0
 
-        def zero_trees():
-            return tuple(
-                jax.tree.map(lambda l: jnp.zeros((W, length(l, slot)),
-                                                 jnp.float32),
-                             self.state.params)
-                for slot in (0, 1))
+        if plan is not None:
+            def zero_trees():
+                return tuple(
+                    tuple(jnp.zeros((W, length(b.numel, slot)), jnp.float32)
+                          for b in plan.buckets)
+                    for slot in (0, 1))
+        else:
+            def zero_trees():
+                return tuple(
+                    jax.tree.map(
+                        lambda l: jnp.zeros((W, length(l.size, slot)),
+                                            jnp.float32),
+                        self.state.params)
+                    for slot in (0, 1))
 
         # ONE jitted call allocates every residual directly sharded (a
         # device_put of materialized (W, n) buffers would stage W copies of
@@ -524,6 +581,20 @@ class TrainingEngine:
         # validated in __init__: stage <= 2, no tp/sp/ep/pp, no offload
         qgz = cfg.zero_optimization.zero_quantized_gradients
 
+        # coalescing plans (built once in __init__; None → legacy paths)
+        plan = self._bucket_plan
+        wire_plan = self._wire_plan
+        grad_out_specs = None
+        if plan is not None:
+            # scatter-bucket leaves exit the shard_map already sharded like
+            # the optimizer state (ZeRO-2); everything else replicated
+            dims = {s.leaf: s.shard_dim
+                    for b in plan.buckets for s in b.slots}
+            opt_leaves, ptd = jax.tree_util.tree_flatten(opt_param_shardings)
+            grad_out_specs = jax.tree_util.tree_unflatten(
+                ptd, [sh.spec if dims.get(i) is not None else P()
+                      for i, sh in enumerate(opt_leaves)])
+
         def step_fn(state: EngineState, batch: Dict[str, jax.Array],
                     residuals=None, lr_scale=None):
             # lr_scale: per-batch LR multiplier from the variable-batch
@@ -563,78 +634,192 @@ class TrainingEngine:
             new_residuals = residuals
             dp_axes = ("dp", "fsdp")
             ws = float(self.topo.dp_world_size)
+            # bucketed paths also coalesce the grad-norm reduction: the
+            # per-shard sum-of-squares rides the stacked metrics psum, so
+            # computing ||g|| outside adds no per-leaf scalar all-reduces
+            gsq = None
 
-            def explicit_dp(local_fn, extra_in=(), extra_specs=()):
-                """Shared scaffolding of the manual-DP wire-compression
-                paths (1-bit and qgZ): params replicated in, batch sharded
-                over dp, grads/metrics replicated out; ``extra`` pytrees
-                (residuals) ride sharded over the dp axes."""
-                from jax import shard_map
+            def explicit_dp(local_fn, extra_in=(), extra_specs=(),
+                            grad_specs=None, norm_out=False):
+                """Shared scaffolding of the manual-DP reduction paths
+                (bucketed exact, 1-bit, qgZ): params replicated in, batch
+                sharded over dp, metrics replicated out; grads come back
+                replicated unless ``grad_specs`` marks a leaf as exiting
+                sharded (ZeRO-2 scatter buckets); ``extra`` pytrees
+                (residuals) ride sharded over the dp axes.  ``norm_out``
+                adds a replicated scalar (the gradient sum-of-squares,
+                psummed inside with the metrics) after the metrics."""
+                from ..compat import shard_map
 
                 batch_specs = jax.tree.map(lambda _: P(None, dp_axes), batch)
                 rep = jax.tree.map(lambda _: P(), state.params)
+                gspec = grad_specs if grad_specs is not None else rep
                 mspec = jax.tree.map(lambda _: P(), zero_metrics)
+                nspec = (P(),) if norm_out else ()
                 return shard_map(
                     local_fn, mesh=self.topo.mesh,
                     in_specs=(rep, batch_specs) + tuple(extra_specs),
-                    out_specs=(rep, mspec) + tuple(extra_specs),
+                    out_specs=(gspec, mspec) + nspec + tuple(extra_specs),
                     check_vma=False)(state.params, batch, *extra_in)
 
             if onebit:
                 # 1-bit Adam wire path (reference runtime/comm/nccl.py
-                # compressed_allreduce): large leaves reduce through the
+                # compressed_allreduce): gradients reduce through the
                 # two-phase sign-compressed scheme with worker + server
                 # error feedback (ops/onebit.py), ~32x less gradient
-                # traffic; small leaves psum exactly.
+                # traffic.  With coalescing the unit of compression is the
+                # BUCKET — one two-phase round trip per bucket, and
+                # sub-block leaves share scale blocks instead of each
+                # padding one out; tiny buckets psum exactly.
                 from ..ops.onebit import onebit_all_reduce
 
                 W = int(self.topo.dp_world_size)
 
-                def local(params, batch, wres, sres):
-                    g, m = accumulate(params, batch)
+                if wire_plan is not None:
+                    from .coalesce import (flatten_bucket, psum_scalars,
+                                           unflatten_bucket)
 
-                    def red(t, w, s):
-                        if t.size >= self._ONEBIT_MIN_NUMEL:
-                            # the primitive computes the MEAN internally —
-                            # pre-dividing (the qgZ sum-semantics convention)
-                            # would shrink compressed grads by another 1/W
-                            out, nw, ns = onebit_all_reduce(
-                                t, w[0], s[0], dp_axes, W,
-                                self._ONEBIT_BLOCK)
-                            return out, nw[None], ns[None]
-                        return jax.lax.psum(t / ws, dp_axes), w, s
+                    def local(params, batch, wres, sres):
+                        g, m = accumulate(params, batch)
+                        leaves, treedef = jax.tree_util.tree_flatten(g)
+                        out = list(leaves)
+                        new_w, new_s = [], []
+                        sq = jnp.zeros((), jnp.float32)
+                        for bi, b in enumerate(wire_plan.buckets):
+                            flat = flatten_bucket(b, leaves)
+                            w, s = wres[bi], sres[bi]
+                            if w.shape[-1] > 0:
+                                # the primitive computes the MEAN internally
+                                # — pre-dividing (the qgZ sum-semantics
+                                # convention) would shrink compressed grads
+                                # by another 1/W
+                                red, nw, ns = onebit_all_reduce(
+                                    flat, w[0], s[0], dp_axes, W,
+                                    self._ONEBIT_BLOCK)
+                                new_w.append(nw[None])
+                                new_s.append(ns[None])
+                            else:  # bucket below _ONEBIT_MIN_NUMEL: exact
+                                red = jax.lax.psum(flat / ws, dp_axes)
+                                new_w.append(w)
+                                new_s.append(s)
+                            sq = sq + jnp.sum(jnp.square(red)) / ws
+                            for i, v in unflatten_bucket(b, red):
+                                out[i] = v
+                        g = jax.tree_util.tree_unflatten(treedef, out)
+                        m, nsq = psum_scalars(m, dp_axes, 1.0 / ws, extra=sq)
+                        return g, m, nsq, tuple(new_w), tuple(new_s)
 
-                    triples = jax.tree.map(red, g, wres, sres)
-                    is3 = lambda x: isinstance(x, tuple) and len(x) == 3
-                    g = jax.tree.map(lambda tr: tr[0], triples, is_leaf=is3)
-                    nw = jax.tree.map(lambda tr: tr[1], triples, is_leaf=is3)
-                    ns = jax.tree.map(lambda tr: tr[2], triples, is_leaf=is3)
-                    m = jax.tree.map(lambda t: jax.lax.psum(t / ws, dp_axes), m)
-                    return g, m, nw, ns
+                    res_spec = tuple(P(dp_axes) for _ in wire_plan.buckets)
+                else:
+                    def local(params, batch, wres, sres):
+                        g, m = accumulate(params, batch)
 
-                res_spec = jax.tree.map(lambda _: P(dp_axes), state.params)
-                grads, msum, new_w, new_s = explicit_dp(
-                    local, extra_in=residuals,
-                    extra_specs=(res_spec, res_spec))
+                        def red(t, w, s):
+                            if t.size >= self._ONEBIT_MIN_NUMEL:
+                                out, nw, ns = onebit_all_reduce(
+                                    t, w[0], s[0], dp_axes, W,
+                                    self._ONEBIT_BLOCK)
+                                return out, nw[None], ns[None]
+                            return jax.lax.psum(t / ws, dp_axes), w, s
+
+                        triples = jax.tree.map(red, g, wres, sres)
+                        is3 = lambda x: isinstance(x, tuple) and len(x) == 3
+                        g = jax.tree.map(lambda tr: tr[0], triples,
+                                         is_leaf=is3)
+                        nw = jax.tree.map(lambda tr: tr[1], triples,
+                                          is_leaf=is3)
+                        ns = jax.tree.map(lambda tr: tr[2], triples,
+                                          is_leaf=is3)
+                        m = jax.tree.map(
+                            lambda t: jax.lax.psum(t / ws, dp_axes), m)
+                        return g, m, nw, ns
+
+                    res_spec = jax.tree.map(lambda _: P(dp_axes),
+                                            state.params)
+                if wire_plan is not None:
+                    grads, msum, gsq, new_w, new_s = explicit_dp(
+                        local, extra_in=residuals,
+                        extra_specs=(res_spec, res_spec), norm_out=True)
+                else:
+                    grads, msum, new_w, new_s = explicit_dp(
+                        local, extra_in=residuals,
+                        extra_specs=(res_spec, res_spec))
                 new_residuals = (new_w, new_s)
             elif qgz:
                 # ZeRO++ qgZ: explicit DP with int8-compressed gradient
                 # reduction (ops/quantizer.compressed_all_reduce) instead of
-                # XLA's exact psum — 4x less gradient traffic over DCN.
+                # XLA's exact psum — 4x less gradient traffic over DCN, one
+                # quantize→all_gather→dequantize round trip per BUCKET when
+                # coalescing is on (fewer compression round trips, full
+                # block utilization for sub-block leaves).
                 # Assumes MEAN-semantics loss/metrics (the ModelSpec contract):
                 # per-shard values are averaged across dp; sum-semantics
                 # outputs would be rescaled by 1/dp_world.
                 from ..ops.quantizer import compressed_all_reduce
 
+                if wire_plan is not None:
+                    from .coalesce import psum_scalars, reduce_bucketed
+
+                    def local(params, batch):
+                        g, m = accumulate(params, batch)
+                        sqs = []
+
+                        def red(b, f):
+                            r = compressed_all_reduce(f / ws, dp_axes)
+                            sqs.append(jnp.sum(jnp.square(r)) / ws)
+                            return r
+
+                        g = reduce_bucketed(wire_plan, g, red)
+                        m, nsq = psum_scalars(m, dp_axes, 1.0 / ws,
+                                              extra=sum(sqs))
+                        return g, m, nsq
+
+                    grads, msum, gsq = explicit_dp(local, norm_out=True)
+                else:
+                    def local(params, batch):
+                        g, m = accumulate(params, batch)
+                        g = jax.tree.map(
+                            lambda t: compressed_all_reduce(t / ws, dp_axes)
+                            if t.ndim >= 1
+                            else jax.lax.psum(t / ws, dp_axes), g)
+                        m = jax.tree.map(
+                            lambda t: jax.lax.psum(t / ws, dp_axes), m)
+                        return g, m
+
+                    grads, msum = explicit_dp(local)
+            elif plan is not None:
+                # Bucketed exact DP (the IPG-bucket role, coalesce.py): the
+                # DP reduction is made explicit so XLA sees ONE psum per
+                # per-dtype bucket — a handful of large collectives instead
+                # of one per parameter leaf.  At ZeRO-2, shard-major buckets
+                # reduce with a single fused psum_scatter whose output IS
+                # the optimizer-state sharding (no re-layout copy).
+                from .coalesce import psum_scalars, reduce_bucketed
+
                 def local(params, batch):
                     g, m = accumulate(params, batch)
-                    g = jax.tree.map(
-                        lambda t: compressed_all_reduce(t / ws, dp_axes)
-                        if t.ndim >= 1 else jax.lax.psum(t / ws, dp_axes), g)
-                    m = jax.tree.map(lambda t: jax.lax.psum(t / ws, dp_axes), m)
-                    return g, m
+                    sqs = []
 
-                grads, msum = explicit_dp(local)
+                    def red(b, f):
+                        r = jax.lax.psum(f / ws, dp_axes)
+                        # replicated: every shard holds the full bucket
+                        sqs.append(jnp.sum(jnp.square(r)) / ws)
+                        return r
+
+                    def red_scatter(b, f):
+                        r = jax.lax.psum_scatter(
+                            f / ws, dp_axes, scatter_dimension=0, tiled=True)
+                        # scattered: each shard owns a disjoint 1/W chunk
+                        sqs.append(jnp.sum(jnp.square(r)))
+                        return r
+
+                    g = reduce_bucketed(plan, g, red, red_scatter)
+                    m, nsq = psum_scalars(m, dp_axes, 1.0 / ws,
+                                          extra=sum(sqs))
+                    return g, m, nsq
+
+                grads, msum, gsq = explicit_dp(
+                    local, grad_specs=grad_out_specs, norm_out=True)
             else:
                 grads, msum = accumulate(state.params, batch)
             metrics = jax.tree.map(lambda m: m / gas, msum)
@@ -653,7 +838,15 @@ class TrainingEngine:
                     grads, opt_param_shardings)
 
             finite = grads_finite(grads) if fp16 else jnp.array(True)
-            grad_norm = optax.global_norm(grads)
+            if gsq is not None:
+                # ||g|| from the in-shard_map sum-of-squares, rescaled the
+                # same way the grads just were (uniform factors commute
+                # through the 2-norm)
+                grad_norm = jnp.sqrt(gsq) / scale_div
+                if fp16:
+                    grad_norm = grad_norm / state.loss_scale.scale
+            else:
+                grad_norm = optax.global_norm(grads)
 
             # --- optimizer update (skipped on overflow) ----------------
             def do_update(operand):

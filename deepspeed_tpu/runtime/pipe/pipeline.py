@@ -43,7 +43,9 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from ...compat import axis_size, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.topology import MeshTopology, get_topology
@@ -157,7 +159,7 @@ def pipeline_apply(layer_params: Dict[str, Any], x: jax.Array, cfg,
 
     def local(layer_params, x):
         me = lax.axis_index("pp")
-        n = lax.axis_size("pp")
+        n = axis_size("pp")
         # per-device shapes: batch/seq may be dp/sp-sharded
         b_l, s_l, h_l = x.shape
         mb_l = b_l // M

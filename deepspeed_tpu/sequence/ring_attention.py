@@ -17,7 +17,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..compat import axis_size, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.topology import get_topology
@@ -63,7 +63,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     s_local = S // sp
 
     def local(q, k, v):
-        n = jax.lax.axis_size("sp")
+        n = axis_size("sp")
         me = jax.lax.axis_index("sp")
         q_offset = me * s_local
         perm = [(i, (i + 1) % n) for i in range(n)]

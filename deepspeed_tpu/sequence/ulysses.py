@@ -21,7 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..compat import axis_size as _axis_size, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.topology import get_topology
@@ -54,7 +54,7 @@ def ulysses_attention_bound(q: jax.Array, k: jax.Array, v: jax.Array,
     (e.g. the pipeline's stage shard_map — pp × sp composition): per-device
     q (B_l, S/sp, H, D) → head↔seq all-to-all → full-sequence attention on
     H/sp local heads → inverse all-to-all."""
-    sp = jax.lax.axis_size(axis)
+    sp = _axis_size(axis)
     inner = attn_fn or _inner_attention
     H = q.shape[2]
     KV = k.shape[2]

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Tier-1 verify wrapper (ROADMAP.md) with a fast collection gate.
+#
+# The gate runs `pytest --collect-only` first: an import break (like the
+# seed's `from jax import shard_map` failure on older JAX) fails in seconds
+# with the real traceback instead of surfacing as per-file collection
+# errors mid-suite.  Then the full tier-1 command runs unchanged.
+#
+# Usage: scripts/t1.sh            # gate + full tier-1 suite
+#        scripts/t1.sh --collect  # gate only (seconds)
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== t1: collection gate =="
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly > /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: COLLECTION FAILED" >&2
+    grep -aE "ERROR|error" /tmp/_t1_collect.log | head -20 >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+tail -1 /tmp/_t1_collect.log
+
+if [ "${1:-}" = "--collect" ]; then
+    exit 0
+fi
+
+echo "== t1: full suite =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -165,12 +165,14 @@ def test_fp6_kernel_matches_dequant_oracle():
 
 
 def test_fp6_quantization_error_bounded():
-    """fp6 e3m2 with per-group scaling: max error = half-ulp of the top
-    binade = (fmax/14)/2 of the group absmax → < 0.3 for N(0,1) weights."""
+    """fp6 e3m2 with per-group scaling: worst-case error is the half-ulp of
+    the top binade, absmax * (ulp/2)/fmax = absmax * 2/28 = absmax/14 per
+    group — bounded here by the global absmax (the worst group's)."""
     w = jax.random.normal(jax.random.PRNGKey(1), (512, 256), jnp.float32)
     qw = quantize_gemm_weight(w, bits=6)
     err = float(jnp.max(jnp.abs(dequantize_gemm_weight(qw) - w)))
-    assert err < 0.3, err
+    bound = float(jnp.max(jnp.abs(w))) / 14 + 1e-6
+    assert err <= bound, (err, bound)
     # and much tighter in relative terms than int4
     qw4 = quantize_gemm_weight(w, bits=4)
     err4 = float(jnp.max(jnp.abs(dequantize_gemm_weight(qw4) - w)))

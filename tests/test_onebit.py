@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from deepspeed_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 import deepspeed_tpu
@@ -96,9 +96,11 @@ def test_onebit_converges_vs_exact(devices):
     assert l_1bit[-1] < l_1bit[4] * 0.5, \
         f"no convergence after compression engaged: {l_1bit}"
     assert l_1bit[-1] < max(4 * l_exact[-1], 0.5), (l_1bit[-1], l_exact[-1])
-    # residuals actually carry feedback (the wire path really ran)
-    res = np.asarray(jax.device_get(onebit._onebit_wres["embed"]["tokens"]))
-    assert np.abs(res).sum() > 0
+    # residuals actually carry feedback (the wire path really ran); with
+    # coalescing they are per-BUCKET arrays, so check the whole tree
+    res_sum = sum(float(np.abs(np.asarray(jax.device_get(x))).sum())
+                  for x in jax.tree.leaves(onebit._onebit_wres))
+    assert res_sum > 0
 
 
 def test_onebit_wire_volume_shrinks(devices):
