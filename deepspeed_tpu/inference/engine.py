@@ -157,6 +157,9 @@ class InferenceEngine:
                 "with it is incoherent; serve with moe_routing='capacity' "
                 "or 'dropless' (dataclasses.replace(cfg, moe_routing=...))")
         self.model_config = dataclasses.replace(model_config, dtype=icfg.dtype)
+        # a training engine in the same process may have pinned the tp×sp
+        # gather anchors — they name mesh axes this engine's mesh lacks
+        tfm.set_embed_activation_sharding(None, None)
         # dp absorbs the remaining devices (params replicated across it)
         self.topo = MeshTopology.from_config(
             MeshConfig(tensor_parallel_size=icfg.tensor_parallel_size))
@@ -165,6 +168,17 @@ class InferenceEngine:
                                       tfm.param_axes(self.model_config,
                                                      params=params),
                                       rules, self.topo)
+        from ..linear.optimized_linear import has_lora
+
+        if has_lora(params) and icfg.quantize_bits:
+            # unmerged LoRA serving keeps the (possibly already-quantized)
+            # base + adapters as-is; the mixed-GEMM WxA16 path doesn't know
+            # LoRAWeight nodes — merge first for a quantized artifact
+            raise ValueError(
+                "quantize_bits with an unmerged LoRA tree is not supported: "
+                "export merged weights (engine.export_merged_weights) and "
+                "serve those quantized, or serve the LoRA tree with "
+                "quantize_bits=0")
         if icfg.quantize_bits:
             # quantize on host FIRST: the chip never holds the fp weights
             # (a model that only fits quantized must not OOM during init)

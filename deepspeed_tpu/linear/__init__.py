@@ -1,0 +1,26 @@
+"""``deepspeed_tpu.linear`` — LoRA + quantized-base PEFT subsystem.
+
+Capability analogue of the reference's ``deepspeed/linear/`` package
+(``LoRAConfig``, ``QuantizationConfig``, ``OptimizedLinear``): frozen-base
+training with tiny trainable adapters, optional quantized base storage,
+adapter-only checkpoints, and merged-weight export for serving.
+"""
+
+from .config import (DEFAULT_TARGET_MODULES, LoRAConfig, PEFTConfig,
+                     QuantizationConfig)
+from .optimized_linear import (ADAPTER_LEAF_KEYS, LoRAWeight, OptimizedLinear,
+                               QuantizedBaseWeight, adapter_only_flat,
+                               apply_lora, expand_axes_for_lora, has_lora,
+                               init_lora_weight, lora_forward,
+                               merge_lora_weights, merge_trainable,
+                               quantize_base_weight, trainable_mask,
+                               trainable_subtree)
+
+__all__ = [
+    "ADAPTER_LEAF_KEYS", "DEFAULT_TARGET_MODULES", "LoRAConfig",
+    "LoRAWeight", "OptimizedLinear", "PEFTConfig", "QuantizationConfig",
+    "QuantizedBaseWeight", "adapter_only_flat", "apply_lora",
+    "expand_axes_for_lora", "has_lora", "init_lora_weight", "lora_forward",
+    "merge_lora_weights", "merge_trainable", "quantize_base_weight",
+    "trainable_mask", "trainable_subtree",
+]

@@ -1,0 +1,89 @@
+"""PEFT / LoRA configuration surface.
+
+Mirrors the reference's ``deepspeed/linear/config.py`` (``LoRAConfig``,
+``QuantizationConfig``) as pydantic models so the same objects serve both as
+the standalone ``deepspeed_tpu.linear`` API and as the ``"peft"`` block of
+the root runtime config (``runtime/config.py``) — one definition, two entry
+points.
+
+Reference semantics kept:
+
+* ``lora_r`` / ``lora_alpha`` — low-rank factor width and the numerator of
+  the classic LoRA scaling ``alpha / r``;
+* ``base_weight_sharding`` — the reference shards the frozen base weight
+  across ranks and gathers on forward (``optimized_linear.py:87``).  Here the
+  same intent maps to *logical-axis* sharding: ``> 1`` keeps the base
+  weight's logical axes so the mesh's tp/fsdp rules shard it; ``1`` (the
+  reference default) strips the non-stack axes so the frozen base replicates;
+* ``QuantizationConfig.q_bits`` / ``mantissa_bits`` — select the codec from
+  ``ops/quantizer.py`` exactly like the reference's fp_quantizer picks a
+  float format: (8, 3) → block-scaled fp8 e4m3, (6, 2) → packed fp6 e3m2,
+  (8, 0) → int8, (4, 0) → packed int4.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from pydantic import Field, model_validator
+
+from ..runtime.config_utils import ConfigError, DSConfigModel
+
+#: projection leaves the LoRA switch targets by default — the qkv/o and MLP
+#: matmuls of models/transformer.py (and HF-converted trees, which use the
+#: same key names)
+DEFAULT_TARGET_MODULES = ["wq", "wk", "wv", "wo", "w_in", "w_out", "w_gate"]
+
+
+class QuantizationConfig(DSConfigModel):
+    """Frozen-base storage format (reference ``linear/config.py:50``)."""
+
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512
+
+    @model_validator(mode="after")
+    def _check_format(self) -> "QuantizationConfig":
+        if (self.q_bits, self.mantissa_bits) not in (
+                (8, 3), (6, 2), (8, 0), (4, 0)):
+            raise ConfigError(
+                f"unsupported quantization format q_bits={self.q_bits} "
+                f"mantissa_bits={self.mantissa_bits}; supported: (8,3)=fp8 "
+                "e4m3, (6,2)=fp6 e3m2, (8,0)=int8, (4,0)=int4")
+        if self.group_size <= 0 or self.group_size % 4:
+            raise ConfigError(
+                f"group_size must be a positive multiple of 4 (fp6 packs 4 "
+                f"codes per 3 bytes), got {self.group_size}")
+        return self
+
+
+class LoRAConfig(DSConfigModel):
+    """LoRA adapter spec (reference ``linear/config.py:15``)."""
+
+    enabled: bool = False
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+    target_modules: List[str] = Field(
+        default_factory=lambda: list(DEFAULT_TARGET_MODULES))
+    #: store the frozen base quantized (dequantized on the fly in forward)
+    quantize_base: bool = False
+    quantization: QuantizationConfig = Field(default_factory=QuantizationConfig)
+
+    @model_validator(mode="after")
+    def _check(self) -> "LoRAConfig":
+        if self.lora_r <= 0:
+            raise ConfigError(f"lora_r must be positive, got {self.lora_r}")
+        if self.base_weight_sharding < 0:
+            raise ConfigError("base_weight_sharding must be >= 0")
+        return self
+
+    @property
+    def scaling(self) -> float:
+        return float(self.lora_alpha) / float(self.lora_r)
+
+
+class PEFTConfig(DSConfigModel):
+    """The root config's ``"peft"`` block."""
+
+    lora: LoRAConfig = Field(default_factory=LoRAConfig)

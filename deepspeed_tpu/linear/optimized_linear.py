@@ -1,0 +1,413 @@
+"""LoRA + quantized-base linear layers (reference: ``deepspeed/linear/``).
+
+The reference's ``OptimizedLinear`` (``deepspeed/linear/optimized_linear.py:18``)
+is an ``nn.Module`` that freezes the base weight — optionally storing it
+quantized (``QuantizedParameter``) — and trains only the low-rank LoRA factors.
+In this functional JAX design the same capability is a *pytree node*,
+:class:`LoRAWeight`, that slots into the existing parameter tree wherever a
+plain ``(…, K, N)`` projection matrix lived:
+
+* ``base`` — the frozen full-rank weight, either a dense array or a
+  :class:`QuantizedBaseWeight` (block-scaled fp8 e4m3 / fp6 e3m2 / int8 / int4
+  codes from ``ops/quantizer.py``, dequantized on the fly in the forward);
+* ``lora_a`` ``(…, K, r)`` / ``lora_b`` ``(…, r, N)`` — the trainable factors,
+  A initialised like the repo's ``_dense_init`` (normal · 1/sqrt(K)), B zeros,
+  so training starts exactly at the base model;
+* ``scaling`` (aux) — the classic ``lora_alpha / lora_r``.
+
+Because the node registers with keyed children, everything downstream —
+``jax.value_and_grad``, optax, ``sharding_for_tree``, ``lax.scan`` layer
+slicing, and the path-based safetensors checkpoint writer — sees named leaves
+(``…/wq/lora_a``) and just works.  Freezing is expressed by
+:func:`trainable_mask` + the ``None``-partition helpers below: the engine
+differentiates/optimizes a tree where frozen leaves are ``None`` (absent), so
+no gradient, optimizer state, or reduction-bucket slot ever exists for the
+base weight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import quantizer as quantizer_ops
+from .config import LoRAConfig, QuantizationConfig
+
+_FP8_DTYPE = jnp.float8_e4m3fn
+
+#: leaf names that constitute the adapter (the only trainable, checkpointable
+#: state of a PEFT run)
+ADAPTER_LEAF_KEYS = ("lora_a", "lora_b")
+
+#: stack axes a LoRA node keeps on its otherwise-replicated factors — these
+#: index *which* matrix (scan layer / expert), not a shard of one matrix
+_STACK_AXES = ("layers", "expert")
+
+
+# ---------------------------------------------------------------------------
+# quantized frozen base
+# ---------------------------------------------------------------------------
+
+
+def _quant_matrix(mat: jax.Array, *, q_bits: int, mantissa_bits: int,
+                  group_size: int) -> Tuple[jax.Array, jax.Array]:
+    if (q_bits, mantissa_bits) == (8, 3):
+        codes, scales = quantizer_ops.quantize_fp8(mat, block_size=group_size)
+        # bitcast so the stored codes are a numpy/safetensors-serializable
+        # integer dtype; bitcast back on dequantize
+        return jax.lax.bitcast_convert_type(codes, jnp.uint8), scales
+    if q_bits == 6:
+        return quantizer_ops.quantize_minifloat(mat, bits=6,
+                                                block_size=group_size)
+    return quantizer_ops.quantize_blockwise(mat, bits=q_bits,
+                                            block_size=group_size)
+
+
+def _dequant_matrix(codes: jax.Array, scales: jax.Array, *, q_bits: int,
+                    mantissa_bits: int, group_size: int,
+                    shape: Tuple[int, ...], dtype) -> jax.Array:
+    if (q_bits, mantissa_bits) == (8, 3):
+        fp8 = jax.lax.bitcast_convert_type(codes, _FP8_DTYPE)
+        return quantizer_ops.dequantize_fp8(fp8, scales, shape=shape,
+                                            dtype=dtype)
+    if q_bits == 6:
+        return quantizer_ops.dequantize_minifloat(codes, scales, bits=6,
+                                                  shape=shape, dtype=dtype)
+    return quantizer_ops.dequantize_blockwise(codes, scales, bits=q_bits,
+                                              block_size=group_size,
+                                              shape=shape, dtype=dtype)
+
+
+@jax.tree_util.register_pytree_with_keys_class
+@dataclasses.dataclass(eq=False)
+class QuantizedBaseWeight:
+    """Frozen base weight stored as block-scaled integer/minifloat codes.
+
+    ``codes``/``scales`` carry the matrix's leading stack dims (``layers`` and
+    optionally ``expert``) so ``lax.scan`` layer slicing and per-layer vmap
+    both work; ``inner_shape`` records the trailing ``(K, N)`` each block
+    grid decodes back to.
+    """
+
+    codes: Any
+    scales: Any
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512
+    inner_shape: Tuple[int, ...] = ()
+
+    def tree_flatten_with_keys(self):
+        children = ((jax.tree_util.GetAttrKey("codes"), self.codes),
+                    (jax.tree_util.GetAttrKey("scales"), self.scales))
+        aux = (self.q_bits, self.mantissa_bits, self.group_size,
+               tuple(self.inner_shape))
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.codes.shape[:-2]) + tuple(self.inner_shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def dequantize(self, dtype=jnp.float32) -> jax.Array:
+        deq = partial(_dequant_matrix, q_bits=self.q_bits,
+                      mantissa_bits=self.mantissa_bits,
+                      group_size=self.group_size,
+                      shape=tuple(self.inner_shape), dtype=dtype)
+        lead = tuple(self.codes.shape[:-2])
+        if not lead:
+            return deq(self.codes, self.scales)
+        codes = self.codes.reshape((-1,) + self.codes.shape[-2:])
+        scales = self.scales.reshape((-1,) + self.scales.shape[-1:])
+        out = jax.vmap(deq)(codes, scales)
+        return out.reshape(lead + tuple(self.inner_shape))
+
+
+def quantize_base_weight(w: jax.Array, qcfg: QuantizationConfig
+                         ) -> QuantizedBaseWeight:
+    """Quantize a ``(…, K, N)`` weight per-matrix (blocks never straddle the
+    stack dims, so a scan-sliced layer dequantizes standalone)."""
+    if w.ndim < 2:
+        raise ValueError(f"need a matrix to quantize, got shape {w.shape}")
+    inner = tuple(w.shape[-2:])
+    lead = tuple(w.shape[:-2])
+    quant = partial(_quant_matrix, q_bits=qcfg.q_bits,
+                    mantissa_bits=qcfg.mantissa_bits,
+                    group_size=qcfg.group_size)
+    if lead:
+        codes, scales = jax.vmap(quant)(w.reshape((-1,) + inner))
+        codes = codes.reshape(lead + codes.shape[1:])
+        scales = scales.reshape(lead + scales.shape[1:])
+    else:
+        codes, scales = quant(w)
+    return QuantizedBaseWeight(codes, scales, qcfg.q_bits,
+                               qcfg.mantissa_bits, qcfg.group_size, inner)
+
+
+# ---------------------------------------------------------------------------
+# the LoRA node
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_with_keys_class
+@dataclasses.dataclass(eq=False)
+class LoRAWeight:
+    """A projection weight decomposed as frozen ``base`` + trainable
+    ``scaling · lora_a @ lora_b`` (reference ``optimized_linear.py:133``)."""
+
+    base: Any
+    lora_a: Any
+    lora_b: Any
+    scaling: float = 1.0
+
+    def tree_flatten_with_keys(self):
+        children = ((jax.tree_util.GetAttrKey("base"), self.base),
+                    (jax.tree_util.GetAttrKey("lora_a"), self.lora_a),
+                    (jax.tree_util.GetAttrKey("lora_b"), self.lora_b))
+        return children, (self.scaling,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux[0])
+
+    def base_materialized(self, dtype=jnp.float32) -> jax.Array:
+        if isinstance(self.base, QuantizedBaseWeight):
+            return self.base.dequantize(dtype)
+        return self.base.astype(dtype)
+
+
+def _is_lora(x: Any) -> bool:
+    return isinstance(x, LoRAWeight)
+
+
+def lora_forward(x: jax.Array, w: LoRAWeight) -> jax.Array:
+    """``x @ base + scaling · (x @ A) @ B``; the base path runs under
+    ``stop_gradient`` so no backward graph ever materializes for it."""
+    dt = x.dtype
+    mat = jax.lax.stop_gradient(w.base_materialized(dt))
+    y = x @ mat
+    ax = x @ w.lora_a.astype(dt)
+    return y + (ax @ w.lora_b.astype(dt)) * w.scaling
+
+
+def init_lora_weight(rng: jax.Array, w: jax.Array, cfg: LoRAConfig
+                     ) -> LoRAWeight:
+    """Wrap an existing dense ``(…, K, N)`` weight as a LoRA node."""
+    k_in, n_out = w.shape[-2:]
+    lead = tuple(w.shape[:-2])
+    a = (jax.random.normal(rng, lead + (k_in, cfg.lora_r), jnp.float32)
+         * (1.0 / math.sqrt(k_in))).astype(w.dtype)
+    b = jnp.zeros(lead + (cfg.lora_r, n_out), w.dtype)
+    base = (quantize_base_weight(w, cfg.quantization)
+            if cfg.quantize_base else w)
+    return LoRAWeight(base, a, b, cfg.scaling)
+
+
+class OptimizedLinear:
+    """Thin stateful wrapper for standalone use (the in-tree training path
+    stores bare :class:`LoRAWeight` nodes; this mirrors the reference's
+    module API for users composing their own models)."""
+
+    def __init__(self, weight: LoRAWeight):
+        self.weight = weight
+
+    @classmethod
+    def init(cls, rng: jax.Array, input_dim: int, output_dim: int,
+             lora_config: Optional[LoRAConfig] = None,
+             dtype=jnp.float32) -> "OptimizedLinear":
+        cfg = lora_config or LoRAConfig(enabled=True)
+        kw, ka = jax.random.split(rng)
+        w = (jax.random.normal(kw, (input_dim, output_dim), jnp.float32)
+             * (1.0 / math.sqrt(input_dim))).astype(dtype)
+        return cls(init_lora_weight(ka, w, cfg))
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return lora_forward(x, self.weight)
+
+
+# ---------------------------------------------------------------------------
+# tree surgery: wrap targets, expand axes, merge back
+# ---------------------------------------------------------------------------
+
+
+def _axes_for_node(node: LoRAWeight, w_axes, base_weight_sharding: int
+                   ) -> LoRAWeight:
+    """Logical axes for a LoRA node, derived from the wrapped weight's axes.
+
+    ``base_weight_sharding == 1`` (the reference default) strips the base's
+    non-stack axes so the frozen copy replicates (or gets picked up by the
+    stage-3 fsdp fallback); any other value keeps the original tp/fsdp axes.
+    The factors keep the base's in/out axis on their full-rank side and leave
+    the rank-``r`` side unsharded.
+    """
+    ndim = node.lora_a.ndim
+    if not (isinstance(w_axes, tuple) and len(w_axes) == ndim):
+        w_axes = (None,) * ndim
+    lead, in_ax, out_ax = w_axes[:-2], w_axes[-2], w_axes[-1]
+    stack_lead = tuple(ax if ax in _STACK_AXES else None for ax in lead)
+    if base_weight_sharding == 1:
+        base_axes = stack_lead + (None, None)
+    else:
+        base_axes = w_axes
+    if isinstance(node.base, QuantizedBaseWeight):
+        q = node.base
+        # codes/scales replace the (K, N) plane with a block grid the logical
+        # in/out axes no longer describe — only the stack axes survive
+        base_axes = QuantizedBaseWeight(stack_lead + (None, None),
+                                        stack_lead + (None,),
+                                        q.q_bits, q.mantissa_bits,
+                                        q.group_size, tuple(q.inner_shape))
+    return LoRAWeight(base_axes,
+                      stack_lead + (in_ax, None),
+                      stack_lead + (None, out_ax),
+                      node.scaling)
+
+
+def apply_lora(params, axes, rng: jax.Array, cfg: LoRAConfig):
+    """Swap every targeted projection in a parameter tree for a LoRA node.
+
+    Returns ``(params', axes')`` transformed together so
+    ``sharding_for_tree``'s prefix matching keeps working.  The ``moe``
+    subtree is left untouched: its expert-parallel dispatch contracts the
+    stacked weights directly and does not route through the dense-projection
+    forward.
+    """
+    targets = set(cfg.target_modules)
+    counter = [0]
+
+    def wrap(v):
+        key = jax.random.fold_in(rng, counter[0])
+        counter[0] += 1
+        return init_lora_weight(key, v, cfg)
+
+    def walk(p, a):
+        new_p = {}
+        new_a = {} if isinstance(a, dict) else a
+        for k, v in p.items():
+            sub_a = a.get(k) if isinstance(a, dict) else a
+            if isinstance(v, dict):
+                if k == "moe":
+                    rp, ra = v, sub_a
+                else:
+                    rp, ra = walk(v, sub_a)
+            elif (k in targets and hasattr(v, "ndim") and v.ndim >= 2
+                  and not isinstance(v, (LoRAWeight, QuantizedBaseWeight))):
+                rp = wrap(v)
+                ra = _axes_for_node(
+                    rp, sub_a if isinstance(sub_a, tuple) else None,
+                    cfg.base_weight_sharding)
+            else:
+                rp, ra = v, sub_a
+            new_p[k] = rp
+            if isinstance(new_a, dict):
+                new_a[k] = ra
+        return new_p, new_a
+
+    if not isinstance(params, dict):
+        raise TypeError("apply_lora expects the dict parameter tree of "
+                        "models/transformer.py (or an HF-converted tree)")
+    return walk(params, axes)
+
+
+def expand_axes_for_lora(axes, params, base_weight_sharding: int = 1):
+    """Post-pass for ``param_axes(cfg, params=…)`` on a tree that already
+    contains LoRA nodes: wherever ``params`` holds a :class:`LoRAWeight` but
+    ``axes`` still has the original weight's plain tuple, expand it."""
+    if not isinstance(params, dict) or not isinstance(axes, dict):
+        return axes
+    out = {}
+    for k, a in axes.items():
+        p = params.get(k) if isinstance(params, dict) else None
+        if isinstance(p, LoRAWeight) and not isinstance(a, LoRAWeight):
+            out[k] = _axes_for_node(p, a if isinstance(a, tuple) else None,
+                                    base_weight_sharding)
+        elif isinstance(a, dict):
+            out[k] = expand_axes_for_lora(a, p if isinstance(p, dict) else {},
+                                          base_weight_sharding)
+        else:
+            out[k] = a
+    return out
+
+
+def has_lora(tree) -> bool:
+    leaves = jax.tree_util.tree_leaves(tree, is_leaf=_is_lora)
+    return any(isinstance(l, LoRAWeight) for l in leaves)
+
+
+def merge_lora_weights(tree, dtype=None):
+    """Fold every LoRA node back into a plain dense weight
+    (``W + scaling · A @ B``) for serving — reference
+    ``OptimizedLinear.merge_lora_weights``."""
+
+    def merge(n: LoRAWeight):
+        mat = n.base_materialized(jnp.float32)
+        delta = jnp.einsum("...kr,...rn->...kn",
+                           n.lora_a.astype(jnp.float32),
+                           n.lora_b.astype(jnp.float32)) * n.scaling
+        out_dt = dtype
+        if out_dt is None:
+            out_dt = (n.lora_a.dtype if isinstance(n.base, QuantizedBaseWeight)
+                      else n.base.dtype)
+        return (mat + delta).astype(out_dt)
+
+    return jax.tree.map(lambda x: merge(x) if _is_lora(x) else x, tree,
+                        is_leaf=_is_lora)
+
+
+# ---------------------------------------------------------------------------
+# trainable-mask partition (consumed by runtime/engine.py)
+# ---------------------------------------------------------------------------
+
+
+def trainable_mask(tree):
+    """Boolean tree, same structure as ``tree``: ``True`` at the LoRA
+    factors, ``False`` everywhere else.  Frozen-base PEFT semantics: ONLY
+    the adapters train — embeddings, norms, and untargeted projections are
+    as frozen as the wrapped bases, so the optimizer state and gradient
+    reductions cover exactly the adapter leaves."""
+
+    def mask(x):
+        if _is_lora(x):
+            return LoRAWeight(jax.tree.map(lambda _: False, x.base),
+                              True, True, x.scaling)
+        return False
+
+    return jax.tree.map(mask, tree, is_leaf=_is_lora)
+
+
+def trainable_subtree(tree, mask):
+    """Replace frozen leaves with ``None`` — absent on flatten, so grads,
+    optimizer state, shardings, and bucket plans built from this template
+    cover adapter leaves only."""
+    return jax.tree.map(lambda p, m: p if m else None, tree, mask)
+
+
+def merge_trainable(trainable, full, mask):
+    """Inverse of :func:`trainable_subtree`: splice updated trainable leaves
+    back into the full tree (frozen leaves taken from ``full``)."""
+    full_leaves, treedef = jax.tree_util.tree_flatten(full)
+    mask_leaves = jax.tree_util.tree_leaves(mask)
+    assert len(full_leaves) == len(mask_leaves), (len(full_leaves),
+                                                  len(mask_leaves))
+    t_iter = iter(jax.tree_util.tree_leaves(trainable))
+    merged = [next(t_iter) if m else p
+              for p, m in zip(full_leaves, mask_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, merged)
+
+
+def adapter_only_flat(flat: dict) -> dict:
+    """Filter a ``flatten_with_paths`` dict down to adapter leaves — the
+    payload of an adapter-only checkpoint."""
+    return {k: v for k, v in flat.items()
+            if k.split("/")[-1] in ADAPTER_LEAF_KEYS}

@@ -1293,7 +1293,13 @@ def params_to_hf(params: Dict[str, Any], cfg: tfm.TransformerConfig,
                  ) -> Dict[str, np.ndarray]:
     """Export a trained param pytree back to the HF state dict of
     ``model_type`` (reference: ``zero_to_fp32``/``save_16bit_model`` — the
-    consolidated export the HF ecosystem reloads)."""
+    consolidated export the HF ecosystem reloads).  A LoRA-trained tree is
+    merged first (adapters folded into the dequantized base), so PEFT runs
+    export exactly like full fine-tunes."""
+    from ..linear.optimized_linear import has_lora, merge_lora_weights
+
+    if has_lora(params):
+        params = merge_lora_weights(params)
     if model_type == "bert":
         return params_to_hf_bert(params, cfg)
     if model_type == "roberta":

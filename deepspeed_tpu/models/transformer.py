@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..linear.optimized_linear import (LoRAWeight, expand_axes_for_lora,
+                                       lora_forward)
 from ..ops.pallas.mixed_gemm import QuantizedWeight, mixed_gemm
 
 
@@ -318,6 +320,9 @@ def param_axes(cfg: TransformerConfig, params: Optional[Dict[str, Any]] = None
             for key, ax in bias_axes.items():
                 if key in have and key not in layer.get(blk, {}):
                     layer.setdefault(blk, {})[key] = ax
+        # trees that already carry LoRA nodes (adapter checkpoints loaded for
+        # unmerged serving) need the per-node axes expansion
+        axes = expand_axes_for_lora(axes, params)
     return axes
 
 
@@ -446,6 +451,33 @@ def resolve_attention(impl: str) -> AttentionFn:
     raise ValueError(f"unknown attn_impl {impl!r}")
 
 
+#: activation sharding pinned around the embedding gather.  On
+#: tensor_parallel × sequence_parallel meshes GSPMD's partitioning of a
+#: gather whose OPERAND is vocab(tp)-sharded and whose INDICES are
+#: seq(sp)-sharded miscompiles — the embedding lookup and the loss-side
+#: ``take_along_axis`` both have that shape, and the result surfaced as NaN
+#: loss (ROADMAP tp×sp item).  The fix is two explicit constraints: the
+#: index tensors (tiny int32 ``(batch, seq)``) are replicated across sp
+#: before the gather, and the embedding-gather output is re-anchored to the
+#: sp-sharded activation layout so downstream propagation is unchanged.
+#: The engine pins both for the duration of each traced step and clears
+#: them afterwards (mirroring ``set_param_streaming``, plus the clear —
+#: the shardings name one engine's mesh and must not outlive its call);
+#: inference clears them at construction too.
+_EMBED_ACTIVATION_SHARDING = None
+_GATHER_INDEX_SHARDING = None
+
+
+def set_embed_activation_sharding(sharding, index_sharding=None) -> None:
+    """Install (or clear, with ``None``) the activation sharding applied to
+    the embedding-gather output whenever it is a ``(batch, seq, embed)``
+    activation, and the sharding applied to ``(batch, seq)`` int gather
+    indices (token ids, shifted labels) right before vocab-dim gathers."""
+    global _EMBED_ACTIVATION_SHARDING, _GATHER_INDEX_SHARDING
+    _EMBED_ACTIVATION_SHARDING = sharding
+    _GATHER_INDEX_SHARDING = index_sharding
+
+
 def embed_tokens(params, token_ids, cfg: TransformerConfig,
                  position_ids=None):
     """Shared embedding preamble — token lookup, gemma sqrt(d) normalizer,
@@ -454,7 +486,12 @@ def embed_tokens(params, token_ids, cfg: TransformerConfig,
     architecture switch cannot silently diverge between engines.
     ``position_ids`` defaults to arange over the trailing token axis."""
     dt = jnp.dtype(cfg.dtype)
+    if _GATHER_INDEX_SHARDING is not None and token_ids.ndim == 2:
+        token_ids = jax.lax.with_sharding_constraint(
+            token_ids, _GATHER_INDEX_SHARDING)
     x = params["embed"]["tokens"].astype(dt)[token_ids]
+    if _EMBED_ACTIVATION_SHARDING is not None and x.ndim == 3:
+        x = jax.lax.with_sharding_constraint(x, _EMBED_ACTIVATION_SHARDING)
     if cfg.embed_scale_by_sqrt_dim:
         x = x * jnp.asarray(cfg.hidden_size ** 0.5, dt)
     if cfg.position == "learned":
@@ -468,7 +505,9 @@ def embed_tokens(params, token_ids, cfg: TransformerConfig,
 
 def _lin(x, p, w_key, b_key):
     w = p[w_key]
-    if isinstance(w, QuantizedWeight):  # W8A16/W4A16 in-kernel dequant
+    if isinstance(w, LoRAWeight):  # frozen (possibly quantized) base + LoRA
+        y = lora_forward(x, w)
+    elif isinstance(w, QuantizedWeight):  # W8A16/W4A16 in-kernel dequant
         y = mixed_gemm(x, w)
     else:
         y = x @ w.astype(x.dtype)
@@ -661,6 +700,11 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array], cfg: Transforme
     logits = forward(params, tokens, cfg, attn_fn=attn_fn)
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
+    if _GATHER_INDEX_SHARDING is not None and labels.ndim == 2:
+        # same tp×sp gather hazard as the embedding lookup: logp is
+        # vocab(tp)-sharded, labels arrive seq(sp)-sharded from the loader
+        labels = jax.lax.with_sharding_constraint(
+            labels, _GATHER_INDEX_SHARDING)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     correct = (logits.argmax(-1) == labels).astype(jnp.float32)
     if mask is None:

@@ -78,7 +78,9 @@ def _unflatten_like(template: Any, flat: Dict[str, np.ndarray]) -> Any:
     paths = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for path, leaf in paths[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        key = "/".join(str(getattr(p, "key",
+                                   getattr(p, "name", getattr(p, "idx", p))))
+                       for p in path)
         if key not in flat:
             raise KeyError(f"checkpoint missing tensor {key!r}")
         leaves.append(flat[key])
@@ -102,7 +104,19 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     # state's device buffers, so the host copy must happen before this
     # function returns, never inside the background thread.  In multi-process
     # the snapshot is a collective (every process gathers; process 0 writes).
-    host_params = _full_host_tree(state.params)
+    peft = bool(getattr(engine, "peft_enabled", False))
+    if peft:
+        # adapter-only checkpoint (reference: PEFT save_pretrained): the
+        # frozen base is reconstructable from the original weights, so only
+        # lora_a/lora_b leaves are written — the trainable subtree (frozen
+        # leaves → None, absent on flatten) is exactly that set, and the
+        # optimizer state below is already adapter-only by construction
+        from ...linear.optimized_linear import trainable_subtree
+
+        host_params = _full_host_tree(
+            trainable_subtree(state.params, engine._trainable_mask))
+    else:
+        host_params = _full_host_tree(state.params)
     if getattr(engine, "offloaded_optimizer", None) is not None:
         host_opt = _full_host_tree(
             engine.offloaded_optimizer.state_for_checkpoint())
@@ -119,6 +133,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "world_size": engine.topo.world_size,
         "client_state": client_state or {},
         "framework_version": _version(),
+        "peft_adapter_only": peft,
     }
 
     # 1-bit wire-compression residuals are optimizer-coupled engine state:
@@ -130,7 +145,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                                        "server": engine._onebit_sres})
 
     def _write_trees():
-        model_path = os.path.join(ckpt_dir, "model.safetensors")
+        model_path = os.path.join(
+            ckpt_dir, "adapter_model.safetensors" if peft
+            else "model.safetensors")
         opt_path = os.path.join(ckpt_dir, "optimizer.safetensors")
         if host_onebit is not None:
             _save_tree(host_onebit,
@@ -234,10 +251,33 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         meta = json.load(f)
     _validate_tag(engine, meta)
 
-    flat_params = _load_tree_flat(os.path.join(ckpt_dir, "model.safetensors"))
-    params = _unflatten_like(engine.state.params, flat_params)
-    params = jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s.sharding),
-                          params, engine.state.params)
+    if meta.get("peft_adapter_only"):
+        if not getattr(engine, "peft_enabled", False):
+            raise ValueError(
+                f"{ckpt_dir} is an adapter-only (PEFT) checkpoint — it holds "
+                "lora_a/lora_b only; load it into an engine with peft.lora "
+                "enabled over the same base model")
+        from ...linear.optimized_linear import (merge_trainable,
+                                                trainable_subtree)
+
+        mask = engine._trainable_mask
+        template = trainable_subtree(engine.state.params, mask)
+        flat_params = _load_tree_flat(
+            os.path.join(ckpt_dir, "adapter_model.safetensors"))
+        loaded = _unflatten_like(template, flat_params)
+        loaded = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s.sharding),
+            loaded, template)
+        # splice the restored adapters over the engine's (frozen, possibly
+        # quantized) base — the base never round-trips through the file
+        params = merge_trainable(loaded, engine.state.params, mask)
+    else:
+        flat_params = _load_tree_flat(
+            os.path.join(ckpt_dir, "model.safetensors"))
+        params = _unflatten_like(engine.state.params, flat_params)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s.sharding),
+            params, engine.state.params)
 
     # delayed-update (DPU) pending gradients predate the load: applying them
     # to the restored params would corrupt the restore — discard
@@ -330,6 +370,44 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                                                engine._onebit_sres)
     log_dist(f"loaded checkpoint {ckpt_dir} (step {meta['step']})")
     return ckpt_dir, meta.get("client_state", {})
+
+
+def export_merged_weights(engine, save_dir: str,
+                          tag: str = "merged") -> str:
+    """Fold every LoRA adapter into its (dequantized) base weight and write
+    the result as a plain full-model safetensors file — the serving artifact
+    (reference: PEFT ``merge_and_unload`` → ``save_pretrained``).  The
+    exported tree has the SAME structure as a never-LoRA'd model, so
+    ``inference.engine.InferenceEngine`` (and any full-checkpoint tooling)
+    consumes it directly via ``load_merged_params``."""
+    from ...linear.optimized_linear import has_lora, merge_lora_weights
+
+    if not has_lora(engine.state.params):
+        raise ValueError("export_merged_weights: engine has no LoRA adapters")
+    host_params = _full_host_tree(engine.state.params)
+    merged = merge_lora_weights(host_params)
+    out_dir = os.path.join(save_dir, tag)
+    if jax.process_index() == 0:
+        with _SAVE_LOCK:
+            os.makedirs(out_dir, exist_ok=True)
+            _save_tree(merged, os.path.join(out_dir, "model.safetensors"))
+            with open(os.path.join(out_dir, "engine_state.json"), "w") as f:
+                json.dump({"merged_lora": True,
+                           "framework_version": _version()}, f, indent=2)
+        log_dist(f"exported merged LoRA weights -> {out_dir}")
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("dstpu_merged_export")
+    return out_dir
+
+
+def load_merged_params(ckpt_dir: str, template: Any) -> Any:
+    """Load a merged-weight export (or any full model.safetensors) into the
+    structure of ``template`` — host numpy leaves, ready for
+    ``InferenceEngine(params=...)`` placement."""
+    flat = _load_tree_flat(os.path.join(ckpt_dir, "model.safetensors"))
+    return _unflatten_like(template, flat)
 
 
 def _validate_tag(engine, meta: Dict) -> None:

@@ -209,6 +209,26 @@ def unflatten_bucket_shard(bucket: Bucket, shard: jax.Array, world: int
     return out
 
 
+def unflatten_bucket_shard_major(bucket: Bucket, flat: jax.Array, world: int
+                                 ) -> List[Tuple[int, jax.Array]]:
+    """Inverse of :func:`flatten_bucket_shard_major` for a FULL buffer of
+    ``numel`` elements (e.g. the output of a tiled ``all_gather`` over every
+    device's ``numel/world`` chunk): rebuild each member leaf at its full
+    shape.  This is the ZeRO-1/2 param re-replication path — one fused
+    all-gather per bucket instead of one per leaf."""
+    rows = flat.reshape(world, -1)
+    out = []
+    for s in bucket.slots:
+        n = s.size // world
+        off = s.offset // world
+        d = s.shard_dim
+        pre, post = s.shape[:d], s.shape[d + 1:]
+        x = rows[:, off:off + n].reshape((world,) + pre
+                                         + (s.shape[d] // world,) + post)
+        out.append((s.leaf, jnp.moveaxis(x, 0, d).reshape(s.shape)))
+    return out
+
+
 def reduce_bucketed(plan: BucketPlan, tree: Any,
                     reduce_flat: Callable[[Bucket, jax.Array], jax.Array],
                     reduce_scatter: Optional[
@@ -287,6 +307,18 @@ def resolve_bucket_numel(zero_cfg) -> int:
             continue
         return int(v)
     return DEFAULT_BUCKET_NUMEL
+
+
+def resolve_allgather_numel(zero_cfg) -> int:
+    """Effective param all-gather bucket capacity (elements):
+    ``allgather_bucket_size`` when set, ``"auto"`` → the reference default,
+    0 disables gather coalescing (per-leaf GSPMD re-replication)."""
+    from .config_utils import is_auto
+
+    v = getattr(zero_cfg, "allgather_bucket_size", None)
+    if v is None or is_auto(v):
+        return DEFAULT_BUCKET_NUMEL
+    return int(v)
 
 
 def shard_dims_for(tree: Any, shardings: Any, dp_axes: Sequence[str],

@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Union
 from pydantic import Field, field_validator, model_validator
 
 from .config_utils import AUTO, ConfigError, DSConfigModel, is_auto
+from ..linear.config import PEFTConfig
 
 
 # ---------------------------------------------------------------------------
@@ -485,6 +486,10 @@ class DeepSpeedTPUConfig(DSConfigModel):
     gradient_compression: GradientCompressionConfig = Field(
         default_factory=GradientCompressionConfig)
     zenflow: ZenFlowConfig = Field(default_factory=ZenFlowConfig)
+    # PEFT / LoRA (reference deepspeed/linear/config.py; lives in
+    # ..linear.config so the standalone linear API and this block share one
+    # definition)
+    peft: PEFTConfig = Field(default_factory=PEFTConfig)
 
     # ------------------------------------------------------------------
     # derived

@@ -18,6 +18,7 @@ sharding makes the per-layer all-gathers part of the scanned program.
 from __future__ import annotations
 
 import collections.abc
+import contextlib
 import dataclasses
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
@@ -167,6 +168,52 @@ class TrainingEngine:
         self.compute_dtype = jnp.dtype(config.compute_dtype)
         self.fp16_enabled = config.fp16.enabled is True
 
+        # ---- PEFT / LoRA (linear/) ------------------------------------
+        # Swap targeted projections for LoRAWeight nodes (frozen — possibly
+        # quantized — base + trainable A/B factors) BEFORE shardings are
+        # derived, so the expanded axes tree drives every placement decision.
+        # Trees that already carry LoRA nodes (restored adapter runs, user-
+        # built models) are detected rather than re-wrapped.
+        from ..linear.optimized_linear import (apply_lora, has_lora,
+                                               merge_trainable,
+                                               trainable_mask,
+                                               trainable_subtree)
+
+        lora_cfg = config.peft.lora
+        if lora_cfg.enabled and not has_lora(model.params):
+            new_params, new_axes = apply_lora(
+                model.params, model.param_axes,
+                jax.random.PRNGKey(config.seed), lora_cfg)
+            model = dataclasses.replace(model, params=new_params,
+                                        param_axes=new_axes)
+            self.model = model
+        self.peft_enabled = has_lora(model.params)
+        self._trainable_mask = None
+        if self.peft_enabled:
+            self._trainable_mask = trainable_mask(model.params)
+            off_o = config.zero_optimization.offload_optimizer
+            off_p = config.zero_optimization.offload_param
+            if (off_o is not None and off_o.device_str != "none") or \
+                    (off_p is not None and off_p.device_str != "none"):
+                raise ConfigError(
+                    "peft.lora + offload_optimizer/offload_param is not "
+                    "supported: the host fp32 master-weight path cannot "
+                    "carry frozen quantized-code leaves, and adapter state "
+                    "is small enough to stay device-resident")
+            if config.zenflow.enabled:
+                raise ConfigError("peft.lora + zenflow is not supported "
+                                  "(zenflow is an offload schedule)")
+            if config.gradient_compression.enabled:
+                raise ConfigError(
+                    "peft.lora + gradient_compression is not supported: "
+                    "adapter gradients are tiny, wire compression would "
+                    "cost more in error-feedback state than it saves")
+            if config.zero_optimization.zero_quantized_weights:
+                raise ConfigError(
+                    "peft.lora + zero_quantized_weights is not supported "
+                    "(the frozen base is already stored quantized; qwZ "
+                    "would re-quantize the stage-3 gathers of int codes)")
+
         # ---- sharding rules ------------------------------------------
         stage = config.zero_optimization.stage
         self.zero_stage = stage
@@ -178,13 +225,24 @@ class TrainingEngine:
         # follow the optimizer rules — computed once, reused everywhere
         self.opt_param_shardings = sharding_for_tree(
             model.params, model.param_axes, self.opt_rules, topo)
+        # PEFT: gradients/optimizer state exist for adapter leaves only — the
+        # trainable template (frozen leaves → None, absent on flatten) is the
+        # shape source for everything gradient-adjacent, and the opt/grad
+        # sharding tree is masked to match
+        if self.peft_enabled:
+            self._trainable_template = trainable_subtree(
+                model.params, self._trainable_mask)
+            self.opt_param_shardings = trainable_subtree(
+                self.opt_param_shardings, self._trainable_mask)
+        else:
+            self._trainable_template = model.params
 
         # ---- optimizer ------------------------------------------------
         base_lr = config.optimizer.params.get("lr", 1e-3)
         self.lr_schedule = create_scheduler(config.scheduler, base_lr=base_lr)
         wd_mask = None
         if config.optimizer.params.get("weight_decay", 0.0):
-            wd_mask = default_weight_decay_mask(model.params)
+            wd_mask = default_weight_decay_mask(self._trainable_template)
         chain = []
         if config.gradient_clipping and config.gradient_clipping > 0:
             chain.append(optax.clip_by_global_norm(config.gradient_clipping))
@@ -316,9 +374,12 @@ class TrainingEngine:
         self._bucket_plan = None   # exact path (scatter buckets at stage ≥2)
         self._wire_plan = None     # compressed paths (flat buckets only)
         if self.reduce_bucket_numel > 0 and explicit_dp_ok:
+            # under PEFT only adapter leaves ever have gradients — buckets
+            # are planned over the trainable template so no slot (and no
+            # reduction traffic) exists for the frozen base
             grad_shapes = jax.tree.map(
                 lambda p: jax.ShapeDtypeStruct(tuple(p.shape), jnp.float32),
-                model.params)
+                self._trainable_template)
             shard_dims = None
             if stage >= 2:
                 # ZeRO-2: leaves whose optimizer sharding splits a dim over
@@ -338,6 +399,58 @@ class TrainingEngine:
                 f"{st['num_buckets']} bucket(s) "
                 f"({st['scatter_buckets']} reduce-scatter), cap="
                 f"{self.reduce_bucket_numel} elements")
+
+        # ---- param all-gather coalescing (ZeRO 1-2; allgather_bucket_size)
+        # At stages 1-2 the optimizer update runs in the dp-sharded layout
+        # and the params come back replicated — which the seed paid for with
+        # one all-gather PER LEAF (11 on the evidence model).  Same bucket
+        # machinery as gradients: shard-major buckets over the leaves whose
+        # optimizer sharding splits a dim across dp, one fused all-gather per
+        # dtype bucket inside the step (reference all_gather_dp_groups /
+        # allgather_bucket_size).
+        from .coalesce import resolve_allgather_numel
+
+        self._gather_plan = None
+        gather_numel = resolve_allgather_numel(config.zero_optimization)
+        if stage in (1, 2) and explicit_dp_ok and gather_numel > 0:
+            param_shapes = jax.tree.map(
+                lambda p: jax.ShapeDtypeStruct(tuple(p.shape), p.dtype),
+                self._trainable_template)
+            g_dims = shard_dims_for(
+                param_shapes, self.opt_param_shardings, ("dp", "fsdp"),
+                {ax: topo.size(ax) for ax in ("dp", "fsdp")})
+            gp = plan_buckets(param_shapes, gather_numel,
+                              world=topo.dp_world_size, shard_dims=g_dims)
+            if any(b.scatter for b in gp.buckets):
+                self._gather_plan = gp
+                gst = gp.stats()
+                log_dist(
+                    f"param-gather coalescing: {gst['num_leaves']} leaves -> "
+                    f"{gst['scatter_buckets']} fused all-gather bucket(s), "
+                    f"cap={gather_numel} elements")
+
+        # ---- tp×sp gather anchoring ----------------------------------
+        # models/transformer.py pins these shardings around the two
+        # vocab-dim gathers (embedding lookup, loss take_along_axis).  On
+        # tensor × sequence parallel meshes GSPMD's partitioning of a gather
+        # with a vocab(tp)-sharded operand and seq(sp)-sharded indices
+        # miscompiles into NaN loss (ROADMAP item); replicating the tiny
+        # int32 index tensors across sp before the gather sidesteps it, and
+        # the activation constraint re-anchors the sp layout downstream.
+        # Installed per-call and cleared afterwards (_anchored_step) — the
+        # step may be traced for several engines in one process, and a
+        # leftover anchor would poison standalone traces of the model on
+        # other meshes; pipeline runs the model inside shard_map where
+        # NamedSharding constraints don't apply.
+        self._embed_act_sharding = None
+        self._gather_index_sharding = None
+        if topo.size("pp") == 1 and (topo.size("sp") > 1
+                                     or topo.size("tp") > 1):
+            self._embed_act_sharding = NamedSharding(
+                topo.mesh, P(("dp", "fsdp"), "sp", None))
+            if topo.size("sp") > 1:
+                self._gather_index_sharding = NamedSharding(
+                    topo.mesh, P(("dp", "fsdp"), None))
 
         # ---- state init (sharded at construction) ---------------------
         self.opt_shardings = None  # set inside _init_state
@@ -422,7 +535,13 @@ class TrainingEngine:
     def _opt_state_shardings(self, params_sharded):
         """Sharding tree for the optimizer state: param-like leaves get the
         *optimizer* rules (ZeRO-1/2 shard them over dp even when params are
-        replicated); scalar counters replicate."""
+        replicated); scalar counters replicate.  Under PEFT the state covers
+        adapter leaves only (frozen base leaves are absent, not zero-sized)."""
+        if self.peft_enabled:
+            from ..linear.optimized_linear import trainable_subtree
+
+            params_sharded = trainable_subtree(params_sharded,
+                                               self._trainable_mask)
         state_shape = jax.eval_shape(self.optimizer.init, params_sharded)
         replicated = NamedSharding(self.topo.mesh, P())
 
@@ -433,6 +552,46 @@ class TrainingEngine:
             self.opt_param_shardings,
             transform_non_params=lambda _leaf: replicated,
         )
+
+    def _coalesced_gather_fn(self, tree):
+        """Re-replicate the ZeRO-1/2 sharded optimizer outputs with ONE fused
+        ``all_gather`` per dtype bucket (``_gather_plan``).  ``tree`` is the
+        updated (trainable) param tree; scatter-bucket leaves enter in their
+        optimizer-state sharding, everything exits replicated."""
+        from ..compat import shard_map
+        from .coalesce import unflatten_bucket_shard_major
+
+        plan = self._gather_plan
+        world = int(self.topo.dp_world_size)
+        dp_axes = ("dp", "fsdp")
+        sh_leaves, treedef = jax.tree_util.tree_flatten(
+            self.opt_param_shardings)
+        scatter_leaves = {s.leaf for b in plan.buckets if b.scatter
+                          for s in b.slots}
+        in_specs = jax.tree_util.tree_unflatten(
+            treedef, [sh.spec if i in scatter_leaves else P()
+                      for i, sh in enumerate(sh_leaves)])
+        rep = jax.tree_util.tree_unflatten(treedef, [P()] * len(sh_leaves))
+
+        def local_fn(t):
+            leaves, td = jax.tree_util.tree_flatten(t)
+            out = list(leaves)
+            for b in plan.buckets:
+                if not b.scatter:
+                    continue
+                # each shard's local row = its slice of every member leaf,
+                # exactly the shard-major layout; tiled all_gather rebuilds
+                # the full buffer in one collective
+                row = jnp.concatenate([out[s.leaf].reshape(-1)
+                                       for s in b.slots])
+                full = jax.lax.all_gather(row, dp_axes, tiled=True)
+                for i, v in unflatten_bucket_shard_major(b, full, world):
+                    out[i] = v
+            return jax.tree_util.tree_unflatten(td, out)
+
+        return shard_map(local_fn, mesh=self.topo.mesh,
+                         in_specs=(in_specs,), out_specs=rep,
+                         check_vma=False)(tree)
 
     def _init_state(self) -> EngineState:
         # The train step donates state buffers, so the engine must own fresh
@@ -464,8 +623,13 @@ class TrainingEngine:
         else:
             opt_shardings = self._opt_state_shardings(params)
             self.opt_shardings = opt_shardings
+            init_params = params
+            if self.peft_enabled:
+                from ..linear.optimized_linear import trainable_subtree
+
+                init_params = trainable_subtree(params, self._trainable_mask)
             opt_state = jax.jit(self.optimizer.init,
-                                out_shardings=opt_shardings)(params)
+                                out_shardings=opt_shardings)(init_params)
         if self.fp16_enabled:
             ls = init_loss_scale(
                 initial_scale_power=self.config.fp16.initial_scale_power,
@@ -564,8 +728,19 @@ class TrainingEngine:
         param_shardings = self.param_shardings
         topo = self.topo
 
+        # PEFT: differentiate w.r.t. the trainable subtree only — frozen
+        # (possibly quantized) base leaves enter the forward as constants, so
+        # no gradient, cotangent buffer, or reduction ever exists for them
+        peft = self.peft_enabled
+        tmask = self._trainable_mask
+        if peft:
+            from ..linear.optimized_linear import (merge_trainable,
+                                                   trainable_subtree)
+
         def microbatch_grads(params, mb, rng, ls_state):
             def scaled_loss(p):
+                if peft:
+                    p = merge_trainable(p, params, tmask)
                 if qwz:
                     # ZeRO++ qwZ: stage-3 gathers ship int8 codes + scales
                     from .zero.qwz import qwz_gather_tree
@@ -574,8 +749,9 @@ class TrainingEngine:
                 loss, metrics = loss_fn(p, mb, rng)
                 return scale_loss(loss, ls_state) if fp16 else loss, metrics
 
+            diff_params = trainable_subtree(params, tmask) if peft else params
             (loss, metrics), grads = jax.value_and_grad(
-                scaled_loss, has_aux=True)(params)
+                scaled_loss, has_aux=True)(diff_params)
             return loss, metrics, grads
 
         # validated in __init__: stage <= 2, no tp/sp/ep/pp, no offload
@@ -610,8 +786,9 @@ class TrainingEngine:
                 lambda s: jnp.zeros((), jnp.float32), metrics_shape)
 
             def accumulate(params, batch):
+                grad_tmpl = trainable_subtree(params, tmask) if peft else params
                 zg = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                                  params)
+                                  grad_tmpl)
 
                 def acc(carry, mb):
                     grads_acc, metrics_acc = carry
@@ -653,7 +830,10 @@ class TrainingEngine:
 
                 batch_specs = jax.tree.map(lambda _: P(None, dp_axes), batch)
                 rep = jax.tree.map(lambda _: P(), state.params)
-                gspec = grad_specs if grad_specs is not None else rep
+                grad_rep = (jax.tree.map(
+                    lambda _: P(), trainable_subtree(state.params, tmask))
+                    if peft else rep)
+                gspec = grad_specs if grad_specs is not None else grad_rep
                 mspec = jax.tree.map(lambda _: P(), zero_metrics)
                 nspec = (P(),) if norm_out else ()
                 return shard_map(
@@ -851,10 +1031,15 @@ class TrainingEngine:
             # --- optimizer update (skipped on overflow) ----------------
             def do_update(operand):
                 params, opt_state, grads = operand
-                updates, new_opt = optimizer.update(grads, opt_state, params)
+                upd_params = (trainable_subtree(params, tmask) if peft
+                              else params)
+                updates, new_opt = optimizer.update(grads, opt_state,
+                                                    upd_params)
                 if lr_scale is not None:
                     updates = jax.tree.map(lambda u: u * lr_scale, updates)
-                new_params = optax.apply_updates(params, updates)
+                new_trainable = optax.apply_updates(upd_params, updates)
+                new_params = (merge_trainable(new_trainable, params, tmask)
+                              if peft else new_trainable)
                 return new_params, new_opt
 
             def skip_update(operand):
@@ -876,6 +1061,18 @@ class TrainingEngine:
                 new_params, new_opt = do_update((state.params, state.opt_state, grads))
                 new_ls = state.loss_scale
                 skipped = state.skipped_steps
+
+            # ZeRO 1-2 coalesced param re-replication: the sharded update's
+            # outputs ride ONE fused all-gather per dtype bucket instead of
+            # one per leaf (reference all_gather_dp_groups with
+            # allgather_bucket_size).  Runs before the canonical pinning so
+            # GSPMD sees already-replicated values and inserts nothing.
+            if self._gather_plan is not None:
+                gathered = self._coalesced_gather_fn(
+                    trainable_subtree(new_params, tmask) if peft
+                    else new_params)
+                new_params = (merge_trainable(gathered, new_params, tmask)
+                              if peft else gathered)
 
             # Pin the new state to its canonical shardings: prevents GSPMD
             # placement drift across steps (e.g. stage-1 params must come back
@@ -1126,25 +1323,26 @@ class TrainingEngine:
         # pre-placed (PrefetchLoader): the H2D transfer was dispatched while
         # the previous step ran
         placed, lr_scale = batch.placed, batch.lr_scale
-        if self.offload_enabled:
-            out = self._train_batch_offloaded(placed, lr_scale)
-        elif (getattr(self, "_train_step_onebit", None) is not None
-                and self.global_steps >= self._onebit_freeze_step()):
-            # 1-bit wire compression engages after the warmup ("freeze")
-            # phase, matching the optimizer's variance freeze — host-side
-            # switch, so each variant stays a single compiled program
-            residuals = (self._onebit_wres, self._onebit_sres)
-            self.state, metrics, residuals = self._train_step_onebit(
-                self.state, placed, residuals, lr_scale)
-            self._onebit_wres, self._onebit_sres = residuals
-            out = LazyMetrics(metrics)
-        else:
-            if lr_scale is None:
-                self.state, metrics = self._train_step(self.state, placed)
+        with self._anchored_step():
+            if self.offload_enabled:
+                out = self._train_batch_offloaded(placed, lr_scale)
+            elif (getattr(self, "_train_step_onebit", None) is not None
+                    and self.global_steps >= self._onebit_freeze_step()):
+                # 1-bit wire compression engages after the warmup ("freeze")
+                # phase, matching the optimizer's variance freeze — host-side
+                # switch, so each variant stays a single compiled program
+                residuals = (self._onebit_wres, self._onebit_sres)
+                self.state, metrics, residuals = self._train_step_onebit(
+                    self.state, placed, residuals, lr_scale)
+                self._onebit_wres, self._onebit_sres = residuals
+                out = LazyMetrics(metrics)
             else:
-                self.state, metrics = self._train_step(self.state, placed,
-                                                       lr_scale)
-            out = LazyMetrics(metrics)
+                if lr_scale is None:
+                    self.state, metrics = self._train_step(self.state, placed)
+                else:
+                    self.state, metrics = self._train_step(self.state, placed,
+                                                           lr_scale)
+                out = LazyMetrics(metrics)
         self.global_steps += 1
         will_read = self.monitor.enabled or (
             self.config.steps_per_print
@@ -1295,8 +1493,29 @@ class TrainingEngine:
         before any call that may trace — engines with different offload_param
         settings can then coexist in one process (tests, hybrid setups)."""
         from .zero.param_offload import set_param_streaming
+        from ..models.transformer import set_embed_activation_sharding
 
         set_param_streaming(self.param_offload_enabled)
+        # same per-call pinning for the tp×sp embed activation anchor: an
+        # inference engine (or an engine on a different mesh) may have
+        # changed it since this engine last traced
+        set_embed_activation_sharding(self._embed_act_sharding,
+                                      self._gather_index_sharding)
+
+    @contextlib.contextmanager
+    def _anchored_step(self):
+        """Pin the trace-time globals for the duration of one engine call,
+        then clear the mesh-specific gather anchors.  The anchors name THIS
+        engine's mesh axes; left installed they would poison any later
+        standalone trace of the model (a bare ``jax.grad`` over ``loss_fn``
+        on the default device would inherit an 8-device sharding)."""
+        from ..models.transformer import set_embed_activation_sharding
+
+        self._assert_streaming_flag()
+        try:
+            yield
+        finally:
+            set_embed_activation_sharding(None, None)
 
     def eval_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         from .data_pipeline.loader import PlacedBatch
@@ -1311,7 +1530,8 @@ class TrainingEngine:
         else:
             placed = self._place_batch(batch)
         flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), placed)
-        metrics = self._eval_step(self.state, flat)
+        with self._anchored_step():
+            metrics = self._eval_step(self.state, flat)
         return {k: float(v) for k, v in metrics.items()}
 
     def _write_monitor(self, metrics: Dict[str, float]) -> None:
@@ -1362,6 +1582,15 @@ class TrainingEngine:
 
         return _load(self, load_dir, tag=tag,
                      load_optimizer_states=load_optimizer_states)
+
+    def export_merged_weights(self, save_dir: str, tag: str = "merged") -> str:
+        """PEFT serving export: fold LoRA adapters into the base weights and
+        write a plain full-model checkpoint (see
+        checkpoint.engine.export_merged_weights)."""
+        self.flush_delayed_update()
+        from .checkpoint.engine import export_merged_weights as _export
+
+        return _export(self, save_dir, tag=tag)
 
     def load_universal_checkpoint(self, root: str, **kwargs) -> str:
         """Ingest a DeepSpeed universal checkpoint (ds_to_universal.py

@@ -21,7 +21,10 @@ def flatten_with_paths(tree: Any) -> Dict[str, Any]:
 
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+        # DictKey → .key, GetAttrKey (LoRAWeight etc.) → .name, SequenceKey
+        # → .idx; str(p) fallback would render GetAttrKey as ".lora_a"
+        key = "/".join(str(getattr(p, "key",
+                                   getattr(p, "name", getattr(p, "idx", p))))
                        for p in path)
         flat[key] = leaf
     return flat

@@ -22,6 +22,16 @@ then
     exit 2
 fi
 tail -1 /tmp/_t1_collect.log
+# the PEFT subsystem suite must be visible to collection — a linear/ import
+# break would otherwise hide all its tests behind a collection error
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_linear.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_linear.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
 
 if [ "${1:-}" = "--collect" ]; then
     exit 0

@@ -59,3 +59,18 @@ def test_per_leaf_baseline_is_worse(devices):
     n_b = bucketed["collectives"].get("all-reduce", 0)
     n_p = per_leaf["collectives"].get("all-reduce", 0)
     assert n_p >= 2 * max(n_b, 1), (bucketed, per_leaf)
+
+
+def test_stage1_coalesced_param_allgather(devices):
+    """ZeRO-1: the post-update parameter all-gathers fuse into dtype buckets
+    (allgather_bucket_size) instead of one all-gather per leaf; disabling
+    the knob re-explodes the count back to ≥ one per sharded leaf."""
+    fused_eng, fused = _census(dict(BASE, zero_optimization={"stage": 1}))
+    assert fused_eng._gather_plan is not None
+    _, per_leaf = _census(dict(BASE, zero_optimization={
+        "stage": 1, "allgather_bucket_size": 0}))
+    n_f = fused["collectives"].get("all-gather", 0)
+    n_p = per_leaf["collectives"].get("all-gather", 0)
+    n_leaves = fused_eng._gather_plan.stats()["num_leaves"]
+    assert n_p >= n_leaves, (per_leaf, n_leaves)
+    assert n_p >= 2 * max(n_f, 1), (fused, per_leaf)

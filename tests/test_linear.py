@@ -1,0 +1,345 @@
+"""deepspeed_tpu.linear — LoRA + quantized-base PEFT subsystem tests.
+
+Covers the ISSUE 3 acceptance surface: LoRA numerics (merged == unmerged,
+frozen base bit-identical across steps), quantized-base codec error bounds,
+adapter-only training at every ZeRO stage with ONLY adapter leaves in the
+optimizer state and gradient buckets (HLO census), adapter-only checkpoint
+roundtrip + size ratio, and merged-weight serving through the inference
+engine.
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.linear import (
+    LoRAConfig,
+    LoRAWeight,
+    OptimizedLinear,
+    QuantizationConfig,
+    adapter_only_flat,
+    apply_lora,
+    has_lora,
+    init_lora_weight,
+    lora_forward,
+    merge_lora_weights,
+    quantize_base_weight,
+    trainable_mask,
+    trainable_subtree,
+)
+from deepspeed_tpu.models import transformer as tfm
+from deepspeed_tpu.runtime.engine import ModelSpec
+from tests.simple_model import copy_task_batch, tiny_lm_spec
+
+PEFT_CFG = {"lora": {"enabled": True, "lora_r": 4, "lora_alpha": 8}}
+
+BASE = {
+    "train_micro_batch_size_per_gpu": 2,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    "steps_per_print": 10_000,
+    "peft": PEFT_CFG,
+}
+
+
+def _engine(**overrides):
+    cfg = dict(BASE)
+    cfg.update(overrides)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_lm_spec(),
+                                               config=cfg)
+    return engine
+
+
+def _adapter_leaf_count(params):
+    flat = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, LoRAWeight))[0]
+    n = 0
+    for _, leaf in flat:
+        if isinstance(leaf, LoRAWeight):
+            n += 2  # lora_a + lora_b
+    return n
+
+
+# ---------------------------------------------------------------------------
+# numerics
+# ---------------------------------------------------------------------------
+
+
+def test_merged_matches_unmerged_forward():
+    rng = jax.random.PRNGKey(0)
+    lin = OptimizedLinear.init(rng, 32, 16,
+                               LoRAConfig(enabled=True, lora_r=4,
+                                          lora_alpha=8))
+    # B initializes to zero; give the adapter a real contribution
+    w = lin.weight
+    b = jax.random.normal(jax.random.PRNGKey(1), w.lora_b.shape) * 0.1
+    w = LoRAWeight(w.base, w.lora_a, b.astype(w.lora_b.dtype), w.scaling)
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 32))
+    unmerged = lora_forward(x, w)
+    merged = merge_lora_weights({"w": w})["w"]
+    np.testing.assert_allclose(np.asarray(x @ merged),
+                               np.asarray(unmerged), rtol=1e-5, atol=1e-5)
+
+
+def test_zero_init_adapter_is_identity():
+    """Fresh LoRA (B = 0) must not perturb the base forward at all."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+    node = init_lora_weight(jax.random.PRNGKey(1), w,
+                            LoRAConfig(enabled=True, lora_r=4))
+    x = jax.random.normal(jax.random.PRNGKey(2), (5, 16))
+    np.testing.assert_allclose(np.asarray(lora_forward(x, node)),
+                               np.asarray(x @ w), rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("q_bits,mantissa_bits,bound", [
+    (8, 3, 0.02),   # fp8 e4m3
+    (6, 2, 0.04),   # fp6 4:3-packed minifloat
+    (8, 0, 0.005),  # int8 blockwise
+    (4, 0, 0.05),   # int4 blockwise
+])
+def test_quantized_base_roundtrip_error(q_bits, mantissa_bits, bound):
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
+    q = quantize_base_weight(w, QuantizationConfig(
+        q_bits=q_bits, mantissa_bits=mantissa_bits, group_size=64))
+    err = np.max(np.abs(np.asarray(q.dequantize(jnp.float32) - w)))
+    assert err < bound, f"({q_bits},{mantissa_bits}) roundtrip err {err}"
+
+
+def test_quantized_base_lora_forward():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
+    cfg = LoRAConfig(enabled=True, lora_r=4, quantize_base=True,
+                     quantization=QuantizationConfig(group_size=64))
+    node = init_lora_weight(jax.random.PRNGKey(1), w, cfg)
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 64))
+    out = lora_forward(x, node)
+    ref = x @ np.asarray(node.base.dequantize(x.dtype))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_config_block_parses():
+    from deepspeed_tpu.runtime.config import load_config
+
+    cfg = load_config({"train_micro_batch_size_per_gpu": 1,
+                       "peft": PEFT_CFG})
+    assert cfg.peft.lora.enabled and cfg.peft.lora.lora_r == 4
+    assert cfg.peft.lora.scaling == 2.0  # alpha/r
+
+
+# ---------------------------------------------------------------------------
+# engine: adapter-only training at every ZeRO stage
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_lora_trains_frozen_base_all_stages(devices, stage):
+    engine = _engine(zero_optimization={"stage": stage})
+    assert engine.peft_enabled and has_lora(engine.state.params)
+
+    # ONLY adapter leaves carry optimizer state
+    n_trainable = len(jax.tree_util.tree_leaves(engine._trainable_template))
+    assert n_trainable == _adapter_leaf_count(engine.state.params)
+
+    base_before = np.array(
+        jax.device_get(engine.state.params["embed"]["tokens"]))
+    wq = engine.state.params["layers"]["attn"]["wq"]
+    a_before = np.array(jax.device_get(wq.lora_a))
+    frozen_wq = np.array(jax.device_get(wq.base))
+
+    rng = np.random.default_rng(0)
+    losses = [engine.train_batch(copy_task_batch(rng, engine.train_batch_size,
+                                                 32))["loss"]
+              for _ in range(3)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+    wq_after = engine.state.params["layers"]["attn"]["wq"]
+    np.testing.assert_array_equal(
+        base_before,
+        np.array(jax.device_get(engine.state.params["embed"]["tokens"])))
+    np.testing.assert_array_equal(frozen_wq,
+                                  np.array(jax.device_get(wq_after.base)))
+    assert not np.array_equal(a_before,
+                              np.array(jax.device_get(wq_after.lora_a)))
+
+
+def test_lora_quantized_base_trains(devices):
+    engine = _engine(peft={"lora": {"enabled": True, "lora_r": 4,
+                                    "lora_alpha": 8, "quantize_base": True,
+                                    "quantization": {"group_size": 32}}},
+                     zero_optimization={"stage": 0})
+    rng = np.random.default_rng(0)
+    losses = [engine.train_batch(copy_task_batch(rng, engine.train_batch_size,
+                                                 32))["loss"]
+              for _ in range(3)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    wq = engine.state.params["layers"]["attn"]["wq"]
+    from deepspeed_tpu.linear import QuantizedBaseWeight
+
+    assert isinstance(wq.base, QuantizedBaseWeight)
+
+
+# ---------------------------------------------------------------------------
+# HLO census: no collective touches frozen-base gradients
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stage", [0, 2])
+def test_hlo_no_base_grad_collectives(devices, stage):
+    """The gradient reduction buckets hold EXACTLY the adapter elements —
+    a frozen-base gradient leaking into the reduction would inflate the
+    bucket plan and the collective payload past the adapter total."""
+    from deepspeed_tpu.profiling.compile_evidence import (
+        hlo_collective_bytes, hlo_collective_census)
+
+    engine = _engine(zero_optimization={"stage": stage})
+    adapter_elems = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(engine._trainable_template))
+    total_elems = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(engine.state.params))
+    assert engine._bucket_plan is not None
+    assert engine._bucket_plan.stats()["total_elements"] == adapter_elems
+    assert adapter_elems < total_elems // 10  # PEFT is actually parameter-efficient
+
+    batch = {"input_ids": np.zeros((engine.train_batch_size, 32), np.int32)}
+    placed = engine._place_batch(batch)
+    hlo = engine._train_step.lower(engine.state, placed).compile().as_text()
+    census = hlo_collective_census(hlo)
+    nbytes = hlo_collective_bytes(hlo)
+    # every reduction payload fits in the adapter total (f32) — the frozen
+    # base (≥10× larger) cannot be hiding in any collective
+    grad_bytes = sum(v for k, v in nbytes.items()
+                     if k in ("all-reduce", "reduce-scatter"))
+    assert grad_bytes <= adapter_elems * 4 * 4 + 4096, (census, nbytes)
+    assert grad_bytes < total_elems * 4, (census, nbytes)
+
+
+# ---------------------------------------------------------------------------
+# adapter-only checkpoints
+# ---------------------------------------------------------------------------
+
+
+def test_adapter_checkpoint_roundtrip(devices, tmp_path):
+    engine = _engine(zero_optimization={"stage": 2})
+    rng = np.random.default_rng(0)
+    engine.train_batch(copy_task_batch(rng, engine.train_batch_size, 32))
+    ckpt = engine.save_checkpoint(str(tmp_path))
+
+    # the model file holds ONLY adapter tensors
+    assert os.path.exists(os.path.join(ckpt, "adapter_model.safetensors"))
+    from safetensors.numpy import load_file
+
+    keys = set(load_file(os.path.join(ckpt, "adapter_model.safetensors")))
+    assert keys and keys == set(adapter_only_flat({k: None for k in keys}))
+
+    saved_wq_a = np.array(jax.device_get(
+        engine.state.params["layers"]["attn"]["wq"].lora_a))
+
+    # diverge, then restore
+    engine.train_batch(copy_task_batch(rng, engine.train_batch_size, 32))
+    moved = np.array(jax.device_get(
+        engine.state.params["layers"]["attn"]["wq"].lora_a))
+    assert not np.array_equal(saved_wq_a, moved)
+    engine.load_checkpoint(str(tmp_path))
+    restored = np.array(jax.device_get(
+        engine.state.params["layers"]["attn"]["wq"].lora_a))
+    np.testing.assert_array_equal(saved_wq_a, restored)
+
+    # training resumes finitely from the restored adapters
+    m = engine.train_batch(copy_task_batch(rng, engine.train_batch_size, 32))
+    assert np.isfinite(m["loss"])
+
+
+def test_adapter_checkpoint_much_smaller_than_full(devices, tmp_path):
+    peft = _engine(zero_optimization={"stage": 0})
+    rng = np.random.default_rng(0)
+    peft.train_batch(copy_task_batch(rng, peft.train_batch_size, 32))
+    pdir = peft.save_checkpoint(str(tmp_path / "peft"))
+
+    full, _, _, _ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(),
+        config={k: v for k, v in BASE.items() if k != "peft"})
+    full.train_batch(copy_task_batch(rng, full.train_batch_size, 32))
+    fdir = full.save_checkpoint(str(tmp_path / "full"))
+
+    adapter = os.path.getsize(os.path.join(pdir, "adapter_model.safetensors"))
+    model = os.path.getsize(os.path.join(fdir, "model.safetensors"))
+    assert adapter * 5 < model, (adapter, model)
+
+
+def test_full_checkpoint_rejected_by_peft_engine(devices, tmp_path):
+    full, _, _, _ = deepspeed_tpu.initialize(
+        model=tiny_lm_spec(),
+        config={k: v for k, v in BASE.items() if k != "peft"})
+    full.save_checkpoint(str(tmp_path))
+    peft = _engine(zero_optimization={"stage": 0})
+    with pytest.raises((ValueError, KeyError)):
+        peft.load_checkpoint(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# merged serving
+# ---------------------------------------------------------------------------
+
+
+def test_merged_export_serves_matching_logits(devices, tmp_path):
+    engine = _engine(zero_optimization={"stage": 0})
+    rng = np.random.default_rng(0)
+    for _ in range(2):
+        engine.train_batch(copy_task_batch(rng, engine.train_batch_size, 32))
+
+    out = engine.export_merged_weights(str(tmp_path))
+    assert os.path.exists(os.path.join(out, "model.safetensors"))
+
+    host_params = jax.device_get(engine.state.params)
+    merged_tmpl = merge_lora_weights(host_params)
+    from deepspeed_tpu.runtime.checkpoint.engine import load_merged_params
+
+    merged = load_merged_params(out, merged_tmpl)
+    assert not has_lora(merged)
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    cfg = tfm.get_config("tiny")
+    icfg = {"tensor_parallel_size": 1, "dtype": "float32"}
+    ie_lora = InferenceEngine(model_config=cfg, params=host_params,
+                              config=icfg)
+    ie_merged = InferenceEngine(model_config=cfg, params=merged, config=icfg)
+
+    prompt = np.array([[5, 9, 2, 7]], np.int32)
+    got_l = ie_lora.generate(prompt, max_new_tokens=6)
+    got_m = ie_merged.generate(prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(got_l, got_m)
+
+
+def test_inference_rejects_quantize_bits_on_lora_tree(devices):
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    spec = tiny_lm_spec()
+    cfg = tfm.get_config("tiny")
+    axes = tfm.param_axes(cfg, params=spec.params)
+    params, _ = apply_lora(spec.params, axes, jax.random.PRNGKey(0),
+                           LoRAConfig(enabled=True, lora_r=4))
+    with pytest.raises(ValueError, match="merged"):
+        InferenceEngine(model_config=cfg, params=params,
+                        config={"quantize_bits": 8})
+
+
+def test_hf_export_merges_lora():
+    from deepspeed_tpu.models.hf_integration import params_to_hf
+
+    spec = tiny_lm_spec()
+    mcfg = tfm.get_config("tiny")
+    axes = tfm.param_axes(mcfg, params=spec.params)
+    params, _ = apply_lora(spec.params, axes, jax.random.PRNGKey(0),
+                           LoRAConfig(enabled=True, lora_r=4))
+    sd = params_to_hf(params, mcfg, model_type="llama")
+    assert all(isinstance(v, np.ndarray) for v in sd.values())
+    assert not any("lora" in k for k in sd)
