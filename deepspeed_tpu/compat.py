@@ -52,6 +52,15 @@ def axis_size(axis_name) -> int:
     return int(getattr(frame, "size", frame))
 
 
+def pallas_any_memory_space():
+    """``ANY`` Pallas TPU memory space across the ``MemorySpace`` (new) /
+    ``TPUMemorySpace`` (≤ 0.4.x) rename."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+    return cls.ANY
+
+
 def tpu_compiler_params(**kwargs):
     """Pallas TPU compiler params across the ``CompilerParams`` (new) /
     ``TPUCompilerParams`` (≤ 0.4.x) rename; same fields either way."""

@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from ..launcher.runner import DEFAULT_COORDINATOR_PORT
 from ..utils.logging import logger
+from ..utils.proc import terminate_procs
 from .elasticity import ElasticityConfig, compute_elastic_config
 
 
@@ -151,16 +152,7 @@ class ElasticAgent:
                     f"(restart {self.restart_count}, port {port}): {members}")
 
     def _stop_group(self) -> None:
-        for p in self.procs:
-            if p.poll() is None:
-                p.terminate()
-        deadline = time.monotonic() + self.cfg.term_timeout_s
-        for p in self.procs:
-            while p.poll() is None and time.monotonic() < deadline:
-                time.sleep(0.05)
-            if p.poll() is None:
-                p.kill()
-                p.wait()
+        terminate_procs(self.procs, term_timeout_s=self.cfg.term_timeout_s)
         self.procs = []
 
     # -- the supervision loop -------------------------------------------

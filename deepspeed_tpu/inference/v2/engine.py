@@ -31,6 +31,15 @@ from .ragged import (DecodeStateTable, KVCacheManager, RaggedBatch,
                      SequenceDescriptor)
 
 
+class AdmissionError(ValueError):
+    """A request cannot be admitted: the prompt+budget exceeds the maximum
+    context, or (``put(strict=True)``) no sequence slot / KV block budget is
+    currently available.  Typed so callers (the serving broker) can convert
+    transient exhaustion into deferral instead of a user-facing failure,
+    and so capacity problems never surface as internal allocator
+    ``MemoryError`` asserts mid-schedule."""
+
+
 @dataclasses.dataclass
 class V2Config:
     max_tokens_per_step: int = 256  # ragged token budget (SplitFuse chunk)
@@ -376,15 +385,59 @@ class InferenceEngineV2:
         self._uid = 0
         self._rng = jax.random.PRNGKey(0)
 
+    # -- capacity accessors (serving metrics / admission control) -------
+    @property
+    def total_blocks(self) -> int:
+        return self.kv.allocator.num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return self.kv.allocator.free_blocks
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    def _blocks_for(self, total_tokens: int) -> int:
+        return -(-total_tokens // self.cfg.block_size)  # ceil
+
+    def _reserved_by_waiting(self) -> int:
+        """Blocks the waiting queue will claim at admission (running
+        sequences already hold their full budget — reserved at admission)."""
+        return sum(self._blocks_for(s.cur_len - s.seen_tokens +
+                                    s.max_new_tokens) for s in self.waiting)
+
     # -- request API ---------------------------------------------------
-    def put(self, prompt_tokens: List[int], max_new_tokens: int = 64) -> int:
+    def put(self, prompt_tokens: List[int], max_new_tokens: int = 64,
+            strict: bool = False) -> int:
+        """Queue a request.  Raises :class:`AdmissionError` if the request
+        could NEVER run (exceeds max context).  With ``strict=True`` it also
+        raises when the engine cannot admit it RIGHT NOW — no free sequence
+        slot, or the block pool (minus what the waiting queue has coming)
+        cannot hold the full prompt+budget reservation.  A strictly-admitted
+        request is therefore guaranteed schedulable on the next step."""
         max_ctx = self.cfg.max_blocks_per_seq * self.cfg.block_size
         need = len(prompt_tokens) + max_new_tokens
         if need > max_ctx:
-            raise ValueError(
+            raise AdmissionError(
                 f"request needs {need} tokens of KV but max context is "
                 f"{max_ctx} (max_blocks_per_seq * block_size); an admitted "
                 "request could never be scheduled")
+        if strict:
+            if self.num_running + self.num_waiting >= self.cfg.max_seqs:
+                raise AdmissionError(
+                    f"all {self.cfg.max_seqs} sequence slots in use "
+                    f"({self.num_running} running, {self.num_waiting} "
+                    "waiting)")
+            avail = self.free_blocks - self._reserved_by_waiting()
+            if self._blocks_for(need) > avail:
+                raise AdmissionError(
+                    f"KV block pool exhausted: request needs "
+                    f"{self._blocks_for(need)} blocks, {avail} unreserved")
         self._uid += 1
         seq = SequenceDescriptor(uid=self._uid, tokens=list(prompt_tokens),
                                  max_new_tokens=max_new_tokens)
@@ -434,6 +487,25 @@ class InferenceEngineV2:
         self.table.retire(seq)
         self.kv.release(seq)
         del self.running[seq.uid]
+
+    def cancel(self, uid: int) -> bool:
+        """Abort a request mid-prefill or mid-decode: retire its table row
+        and return every KV block to the pool.  Safe between steps (the
+        serving broker serializes cancels onto the engine thread).  Returns
+        False if the uid is unknown / already finished."""
+        for seq in self.waiting:
+            if seq.uid == uid:
+                self.waiting.remove(seq)
+                self.kv.release(seq)  # waiting seqs hold no blocks; belt+braces
+                seq.done = True
+                return True
+        seq = self.running.get(uid)
+        if seq is None:
+            return False
+        if not seq.in_decode:
+            self._prefilling -= 1
+        self._finish(seq)
+        return True
 
     def _table_inputs(self):
         """Decode dispatch inputs straight off the SoA table (padded static

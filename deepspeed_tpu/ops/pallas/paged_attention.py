@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ... import compat
+
 
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
@@ -176,8 +178,8 @@ def paged_decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         grid=(S,),
         in_specs=[
             pl.BlockSpec((1, H, D), lambda s, *_: (s, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=compat.pallas_any_memory_space()),
+            pl.BlockSpec(memory_space=compat.pallas_any_memory_space()),
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda s, *_: (s, 0, 0)),
         scratch_shapes=[
@@ -374,8 +376,8 @@ def paged_prefill_attention(q: jax.Array, k_cache: jax.Array,
         grid=(S, Qp // tq),
         in_specs=[
             pl.BlockSpec((1, tq, H, D), lambda s, t, *_: (s, t, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=compat.pallas_any_memory_space()),
+            pl.BlockSpec(memory_space=compat.pallas_any_memory_space()),
         ],
         out_specs=pl.BlockSpec((1, tq, H, D), lambda s, t, *_: (s, t, 0, 0)),
         scratch_shapes=[

@@ -1,0 +1,37 @@
+"""deepspeed_tpu.serving — MII/FastGen-style persistent serving layer.
+
+Layers, bottom-up:
+
+* :mod:`.broker` — request lifecycle over one continuous-batching
+  :class:`~deepspeed_tpu.inference.v2.engine.InferenceEngineV2` (bounded
+  admission queue, deadlines, cancellation, streaming delivery);
+* :mod:`.balancer` — replica pool with least-outstanding-tokens routing,
+  health checks, and transparent retry on replica death;
+* :mod:`.server` — OpenAI-compatible HTTP front (``/v1/completions``
+  streaming + unary, ``/healthz``, ``/metrics``) with 429 backpressure;
+* :mod:`.metrics` — TTFT/TPOT/queue-depth/KV-utilization/goodput counters
+  emitted as ``monitor`` Events.
+
+Quick start (tiny model, CPU)::
+
+    python -m deepspeed_tpu.serving.server --model tiny --port 8000
+    curl -s localhost:8000/v1/completions -d \
+        '{"prompt": [5, 6, 7], "max_tokens": 8}'
+"""
+
+from .balancer import BalancedHandle, NoReplicaError, ReplicaPool
+from .broker import (BrokerStoppedError, InvalidRequestError, QueueFullError,
+                     RequestBroker, RequestFailedError, RequestHandle,
+                     RequestState)
+from .config import ServingConfig
+from .metrics import ServingMetrics
+from .server import (ServingHTTPServer, create_server,
+                     launch_server_subprocess, stop_server)
+
+__all__ = [
+    "BalancedHandle", "BrokerStoppedError", "InvalidRequestError",
+    "NoReplicaError", "QueueFullError", "ReplicaPool", "RequestBroker",
+    "RequestFailedError", "RequestHandle", "RequestState", "ServingConfig",
+    "ServingHTTPServer", "ServingMetrics", "create_server",
+    "launch_server_subprocess", "stop_server",
+]

@@ -1,0 +1,262 @@
+"""Replica load balancer: MII-style deployment over N engine replicas.
+
+Capability analogue of DeepSpeed-MII's ``LoadBalancer`` process
+(``mii/grpc_related/``: a front that round-robins REST/gRPC requests over
+replica processes). TPU adaptation: replicas are in-process
+:class:`~deepspeed_tpu.serving.broker.RequestBroker` instances sharing one
+(immutable) param pytree — JAX arrays are freely shared across threads, so
+one host serves N independent continuous-batching engines without N copies
+of the weights.  Multi-host deployments front one HTTP server per host
+(``python -m deepspeed_tpu.serving.server``) launched/supervised by the
+elasticity machinery; teardown goes through the shared
+``utils.proc.terminate_procs`` grace-period helper either way.
+
+Routing is **least-outstanding-tokens** (queued prompt tokens + undelivered
+generation budget), a closer proxy for engine load than request count when
+lengths are mixed.  A replica that dies mid-request fails its streams with
+``replica_dead``; the pool transparently resubmits on a surviving replica
+with backoff, replaying the (deterministic, greedy) prefix and skipping the
+tokens the client already received.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterator, List, Optional, Sequence
+
+from ..monitor.monitor import Monitor
+from ..utils.logging import logger
+from .broker import (BrokerStoppedError, QueueFullError, RequestBroker,
+                     RequestFailedError, RequestHandle)
+from .config import ServingConfig
+from .metrics import ServingMetrics
+
+
+class NoReplicaError(RuntimeError):
+    """No healthy replica available — surface as HTTP 503."""
+
+
+_RETRYABLE = ("replica_dead", "engine_error", "shutdown")
+
+
+class BalancedHandle:
+    """A request handle that survives replica death: wraps the current
+    replica's :class:`RequestHandle` and, on a retryable failure, resubmits
+    to another healthy replica, skipping already-delivered tokens (greedy
+    decode replays deterministically; with temperature > 0 the retried
+    suffix is a fresh sample)."""
+
+    def __init__(self, pool: "ReplicaPool", handle: RequestHandle,
+                 replica_index: int, submit_kwargs: dict):
+        self._pool = pool
+        self._handle = handle
+        self.replica_index = replica_index
+        self._kwargs = submit_kwargs
+        self._delivered = 0
+        self._cancelled = False
+
+    @property
+    def rid(self) -> str:
+        return self._handle.rid
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self._handle.finish_reason
+
+    @property
+    def prompt(self) -> List[int]:
+        return self._handle.prompt
+
+    def cancel(self) -> None:
+        self._cancelled = True
+        self._handle.cancel()
+
+    def tokens(self, timeout: Optional[float] = None) -> Iterator[int]:
+        attempts = 0
+        while True:
+            seen_this_handle = 0
+            try:
+                for tok in self._handle.tokens(timeout=timeout):
+                    seen_this_handle += 1
+                    if seen_this_handle <= self._delivered:
+                        continue  # replayed prefix after a retry
+                    self._delivered += 1
+                    yield tok
+                return
+            except RequestFailedError as e:
+                if (self._cancelled or e.reason not in _RETRYABLE
+                        or attempts >= self._pool.cfg.retry_limit):
+                    if e.reason in _RETRYABLE:  # gave up: now it's a failure
+                        self._pool.metrics.record_finish("error")
+                    raise
+                attempts += 1
+                time.sleep(self._pool.cfg.retry_backoff_s * attempts)
+                logger.warning(
+                    f"serving: retrying {self._handle.rid} after "
+                    f"{e.reason} (attempt {attempts})")
+                self._handle, self.replica_index = \
+                    self._pool._resubmit(self._kwargs)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        return list(self.tokens(timeout=timeout))
+
+
+class ReplicaPool:
+    """Owns the replica brokers, routes requests, pumps metrics/health."""
+
+    def __init__(self, brokers: Sequence[RequestBroker], config: ServingConfig,
+                 metrics: Optional[ServingMetrics] = None,
+                 monitor: Optional[Monitor] = None):
+        if not brokers:
+            raise ValueError("need at least one replica")
+        self.replicas: List[RequestBroker] = list(brokers)
+        self.cfg = config
+        self.metrics = metrics or ServingMetrics()
+        self.monitor = monitor
+        self._accepting = False
+        self._rr = 0  # round-robin tiebreak cursor
+        self._lock = threading.Lock()
+        self._pump: Optional[threading.Thread] = None
+        self._pump_stop = threading.Event()
+        self._emit_step = 0
+
+    @classmethod
+    def build(cls, engine_factory: Callable[[], "object"],
+              config: ServingConfig,
+              metrics: Optional[ServingMetrics] = None,
+              monitor: Optional[Monitor] = None) -> "ReplicaPool":
+        """Construct ``config.num_replicas`` brokers from an engine factory
+        (each call must return a FRESH InferenceEngineV2 over shared
+        params)."""
+        metrics = metrics or ServingMetrics()
+        brokers = [RequestBroker(engine_factory(), config, metrics=metrics,
+                                 name=f"replica{i}", own_gauges=False)
+                   for i in range(config.num_replicas)]
+        return cls(brokers, config, metrics=metrics, monitor=monitor)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self, paused: bool = False) -> "ReplicaPool":
+        """Start accepting; ``paused=True`` accepts (and queues) requests
+        without starting the engine threads — deterministic backpressure in
+        tests; call ``start_engines()`` to begin serving them."""
+        self._accepting = True
+        if not paused:
+            self.start_engines()
+        self._pump_stop.clear()
+        self._pump = threading.Thread(target=self._pump_loop,
+                                      name="dstpu-serving-metrics",
+                                      daemon=True)
+        self._pump.start()
+        return self
+
+    def start_engines(self) -> None:
+        for b in self.replicas:
+            b.start()
+
+    def healthy_replicas(self) -> List[int]:
+        return [i for i, b in enumerate(self.replicas) if b.healthy()]
+
+    def kill_replica(self, index: int, reason: str = "replica_dead") -> None:
+        self.replicas[index].kill(reason)
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Graceful shutdown: stop accepting, let outstanding requests
+        finish inside the grace window, then stop the engine threads."""
+        self._accepting = False
+        timeout = self.cfg.drain_timeout_s if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        for b in self.replicas:
+            if b.healthy():
+                b.stop(drain=True,
+                       timeout=max(0.0, deadline - time.monotonic()))
+        self._stop_pump()
+
+    def shutdown(self) -> None:
+        """Immediate shutdown: outstanding requests fail with ``shutdown``."""
+        self._accepting = False
+        for b in self.replicas:
+            if b.healthy():
+                b.stop(drain=False, timeout=10.0)
+        self._stop_pump()
+
+    def _stop_pump(self) -> None:
+        self._pump_stop.set()
+        if self._pump is not None:
+            self._pump.join(timeout=5.0)
+            self._pump = None
+
+    # -- routing ---------------------------------------------------------
+
+    def _pick(self, exclude: Sequence[int] = ()) -> int:
+        healthy = [i for i in self.healthy_replicas() if i not in exclude]
+        if not healthy:
+            raise NoReplicaError("no healthy replica")
+        with self._lock:
+            self._rr += 1
+            rr = self._rr
+        # least outstanding tokens; stable round-robin among ties
+        return min(healthy,
+                   key=lambda i: (self.replicas[i].outstanding_tokens(),
+                                  (i - rr) % len(self.replicas)))
+
+    def submit(self, prompt: Sequence[int], **kwargs) -> BalancedHandle:
+        if not self._accepting:
+            raise NoReplicaError("pool not accepting (draining/stopped)")
+        kwargs = dict(kwargs, prompt=list(prompt))
+        handle, idx = self._resubmit(kwargs, fresh=True)
+        return BalancedHandle(self, handle, idx, kwargs)
+
+    def _resubmit(self, kwargs: dict, fresh: bool = False):
+        """Place (or re-place after replica death) a request; tries every
+        healthy replica before giving up. Queue-full only counts as
+        backpressure when EVERY healthy replica's queue is full."""
+        tried: List[int] = []
+        last: Optional[Exception] = None
+        while True:
+            try:
+                idx = self._pick(exclude=tried)
+            except NoReplicaError:
+                if isinstance(last, QueueFullError):
+                    raise last
+                raise
+            tried.append(idx)
+            try:
+                return self.replicas[idx].submit(**kwargs), idx
+            except (QueueFullError, BrokerStoppedError) as e:
+                last = e
+
+    # -- observability ---------------------------------------------------
+
+    def queue_depth(self) -> int:
+        return sum(b.queue_depth() for b in self.replicas)
+
+    def health(self) -> dict:
+        reps = []
+        for i, b in enumerate(self.replicas):
+            reps.append({
+                "index": i, "healthy": b.healthy(),
+                "queue_depth": b.queue_depth(),
+                "outstanding_tokens": b.outstanding_tokens(),
+                "running": b.engine.num_running,
+                "kv_utilization": round(b.kv_utilization(), 4),
+            })
+        return {"status": "ok" if self.healthy_replicas() else "down",
+                "accepting": self._accepting, "replicas": reps}
+
+    def _update_gauges(self) -> None:
+        running = sum(b.engine.num_running for b in self.replicas)
+        kv = [b.kv_utilization() for i, b in enumerate(self.replicas)
+              if b.healthy()]
+        self.metrics.set_gauges(self.queue_depth(), running,
+                                sum(kv) / len(kv) if kv else 0.0)
+
+    def _pump_loop(self) -> None:
+        while not self._pump_stop.wait(self.cfg.metrics_interval_s):
+            self._update_gauges()
+            self._emit_step += 1
+            try:
+                self.metrics.emit_to(self.monitor, self._emit_step)
+            except Exception as e:  # sink failure must not kill serving
+                logger.warning(f"serving metrics emit failed: {e!r}")

@@ -1,0 +1,167 @@
+"""Offered-load sweep against the HTTP serving front.
+
+Drives the real deployment end to end — server subprocess (via
+``launch_server_subprocess``), HTTP clients, streaming responses — at a
+ladder of offered request rates, and records client-observed p50/p95 TTFT,
+end-to-end latency, delivered tokens/s, and 429 backpressure counts into
+``BENCH_EVIDENCE.json`` under ``serving``.
+
+    python -m deepspeed_tpu.serving.bench --out BENCH_EVIDENCE.json
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from typing import List, Optional
+
+from .metrics import _percentile
+from .server import launch_server_subprocess, stop_server
+
+
+def _one_request(host: str, port: int, prompt: List[int], max_tokens: int,
+                 out: dict, lock: threading.Lock) -> None:
+    t0 = time.monotonic()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": prompt, "max_tokens": max_tokens,
+                                 "stream": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status == 429:
+            resp.read()
+            with lock:
+                out["rejected"] += 1
+            return
+        if resp.status != 200:
+            resp.read()
+            with lock:
+                out["failed"] += 1
+            return
+        ttft = None
+        ntok = 0
+        for raw in resp:
+            raw = raw.strip()
+            if not raw.startswith(b"data: "):
+                continue
+            data = raw[6:]
+            if data == b"[DONE]":
+                break
+            if json.loads(data)["choices"][0].get("token") is not None:
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                ntok += 1
+        conn.close()
+        with lock:
+            out["completed"] += 1
+            out["tokens"] += ntok
+            if ttft is not None:
+                out["ttft_s"].append(ttft)
+            out["e2e_s"].append(time.monotonic() - t0)
+    except Exception:
+        with lock:
+            out["failed"] += 1
+
+
+def sweep_point(host: str, port: int, rate_rps: float, duration_s: float,
+                max_tokens: int, prompt_len: int) -> dict:
+    """Open-loop offered load: launch requests on a fixed arrival schedule
+    regardless of completions (the honest way to observe backpressure)."""
+    out = {"completed": 0, "rejected": 0, "failed": 0, "tokens": 0,
+           "ttft_s": [], "e2e_s": []}
+    lock = threading.Lock()
+    threads = []
+    n = int(rate_rps * duration_s)
+    t0 = time.monotonic()
+    for i in range(n):
+        target = t0 + i / rate_rps
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        prompt = [1 + (7 * i + j) % 250 for j in range(prompt_len)]
+        th = threading.Thread(target=_one_request,
+                              args=(host, port, prompt, max_tokens, out, lock))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=180)
+    wall = time.monotonic() - t0
+    return {
+        "offered_rps": rate_rps,
+        "requests": n,
+        "completed": out["completed"],
+        "rejected_429": out["rejected"],
+        "failed": out["failed"],
+        "goodput_rps": round(out["completed"] / wall, 2),
+        "tokens_per_s": round(out["tokens"] / wall, 1),
+        "ttft_s_p50": round(_percentile(out["ttft_s"], 0.50), 4),
+        "ttft_s_p95": round(_percentile(out["ttft_s"], 0.95), 4),
+        "e2e_s_p50": round(_percentile(out["e2e_s"], 0.50), 4),
+        "e2e_s_p95": round(_percentile(out["e2e_s"], 0.95), 4),
+    }
+
+
+def run_sweep(rates: List[float], duration_s: float = 8.0,
+              max_tokens: int = 8, prompt_len: int = 6,
+              replicas: int = 2, max_queue: int = 16,
+              env: Optional[dict] = None) -> dict:
+    proc, base_url = launch_server_subprocess(
+        ["--model", "tiny", "--port", "0", "--replicas", str(replicas),
+         "--max_queue", str(max_queue)], env=env)
+    host, port = base_url.rsplit("//", 1)[1].rsplit(":", 1)
+    port = int(port)
+    try:
+        # warm the compile caches so the sweep measures serving, not XLA
+        warm = {"completed": 0, "rejected": 0, "failed": 0, "tokens": 0,
+                "ttft_s": [], "e2e_s": []}
+        _one_request(host, port, [1, 2, 3], 4, warm, threading.Lock())
+        points = [sweep_point(host, port, r, duration_s, max_tokens,
+                              prompt_len) for r in rates]
+    finally:
+        rc = stop_server(proc)
+    return {
+        "subject": "tiny model, JAX_PLATFORMS=cpu, streaming /v1/completions",
+        "replicas": replicas, "max_queue": max_queue,
+        "max_tokens": max_tokens, "prompt_len": prompt_len,
+        "duration_s_per_point": duration_s,
+        "graceful_shutdown_rc": rc,
+        "sweep": points,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="dstpu-serving-bench")
+    p.add_argument("--out", default=None,
+                   help="merge results into this BENCH_EVIDENCE.json")
+    p.add_argument("--rates", default="2,8,24")
+    p.add_argument("--duration_s", type=float, default=8.0)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--max_queue", type=int, default=16)
+    args = p.parse_args(argv)
+
+    rates = [float(r) for r in args.rates.split(",")]
+    result = run_sweep(rates, duration_s=args.duration_s,
+                       replicas=args.replicas, max_queue=args.max_queue)
+    print(json.dumps(result, indent=2))
+    if args.out:
+        try:
+            with open(args.out) as f:
+                evidence = json.load(f)
+        except FileNotFoundError:
+            evidence = {}
+        evidence["serving"] = result
+        with open(args.out, "w") as f:
+            json.dump(evidence, f, indent=1)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,434 @@
+"""Request broker: the persistent-serving request lifecycle over one
+:class:`~deepspeed_tpu.inference.v2.engine.InferenceEngineV2`.
+
+Capability analogue of DeepSpeed-MII's async server stack
+(``mii/batching/ragged_batching.py`` ``RaggedRequestBatch`` /
+``MIIAsyncPipeline``: request queues feeding the persistent FastGen engine
+thread, per-request streaming back through result queues).
+
+Lifecycle::
+
+    QUEUED --admit--> PREFILL --first token--> DECODE --budget/stop--> DONE
+       \\--deadline/cancel--> CANCELLED / FAILED (any pre-terminal state)
+
+One dedicated **engine thread** owns every JAX call: it admits queued
+requests with ``engine.put(strict=True)`` — an :class:`AdmissionError`
+(pool or slot exhaustion) defers admission instead of failing the request —
+runs the continuous-batching ``step()`` loop, fans tokens out to per-request
+delivery queues, sheds requests past their SLO deadline, and executes
+cancellations (returning the sequence's KV blocks to the pool).  HTTP
+threads only touch the bounded admission queue and the delivery queues, so
+the engine needs no internal locking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..inference.v2.engine import AdmissionError, InferenceEngineV2
+from ..utils.logging import logger
+from .config import ServingConfig
+from .metrics import ServingMetrics
+
+
+class QueueFullError(RuntimeError):
+    """Bounded admission queue is full — surface as HTTP 429 backpressure."""
+
+
+class InvalidRequestError(ValueError):
+    """Malformed request (empty prompt, impossible budget, bad params)."""
+
+
+class BrokerStoppedError(RuntimeError):
+    """Broker is shutting down / dead and not accepting requests."""
+
+
+class RequestFailedError(RuntimeError):
+    """Terminal failure delivered through the token stream (deadline shed,
+    replica death, engine error). ``reason`` is machine-readable."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    DONE = "done"
+    CANCELLED = "cancelled"
+    FAILED = "failed"
+
+
+_TERMINAL = (RequestState.DONE, RequestState.CANCELLED, RequestState.FAILED)
+_rid_counter = itertools.count(1)
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: str
+    prompt: List[int]
+    max_new_tokens: int
+    stop_ids: frozenset
+    deadline: Optional[float]  # absolute monotonic, None = no SLO
+    submit_ts: float
+    state: RequestState = RequestState.QUEUED
+    uid: Optional[int] = None
+    delivered: int = 0
+    first_token_ts: Optional[float] = None
+    last_token_ts: Optional[float] = None
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+    out_q: "queue.Queue" = dataclasses.field(default_factory=queue.Queue)
+
+
+class RequestHandle:
+    """Client-side view of one request: a blocking token iterator, a
+    collecting ``result()``, and ``cancel()``."""
+
+    def __init__(self, broker: "RequestBroker", req: _Request):
+        self._broker = broker
+        self._req = req
+
+    @property
+    def rid(self) -> str:
+        return self._req.rid
+
+    @property
+    def state(self) -> RequestState:
+        return self._req.state
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self._req.finish_reason
+
+    @property
+    def prompt(self) -> List[int]:
+        return self._req.prompt
+
+    def cancel(self) -> None:
+        self._broker.cancel(self._req.rid)
+
+    def tokens(self, timeout: Optional[float] = None) -> Iterator[int]:
+        """Yield generated tokens as they stream; ends cleanly on completion
+        or cancellation, raises :class:`RequestFailedError` on deadline shed,
+        replica death, or engine failure."""
+        while True:
+            kind, payload = self._req.out_q.get(timeout=timeout)
+            if kind == "tok":
+                yield payload
+            elif kind == "done":
+                return
+            else:  # "err"
+                raise RequestFailedError(payload[0], payload[1])
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        return list(self.tokens(timeout=timeout))
+
+
+class RequestBroker:
+    """See module docstring.  ``engine`` must be a fresh
+    :class:`InferenceEngineV2`; the broker's engine thread becomes its sole
+    driver.  Construct, (optionally) ``submit()`` while paused, then
+    ``start()``."""
+
+    def __init__(self, engine: InferenceEngineV2, config: ServingConfig,
+                 metrics: Optional[ServingMetrics] = None,
+                 name: str = "replica0", own_gauges: bool = True):
+        self.engine = engine
+        self.cfg = config
+        self.metrics = metrics or ServingMetrics()
+        self.name = name
+        self._own_gauges = own_gauges  # pool-managed brokers leave gauges to the pump
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._queue: Deque[_Request] = deque()
+        self._by_uid: Dict[int, _Request] = {}
+        self._by_rid: Dict[str, _Request] = {}
+        self._cancels: List[str] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._drain = False
+        self._dead: Optional[str] = None  # kill/crash reason
+
+    # -- client surface (any thread) ------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: Optional[int] = None,
+               temperature: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               stop_token_ids: Sequence[int] = (),
+               rid: Optional[str] = None) -> RequestHandle:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise InvalidRequestError("prompt must be a non-empty token list")
+        mnt = self.cfg.default_max_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        if mnt <= 0:
+            raise InvalidRequestError("max_tokens must be positive")
+        max_ctx = (self.engine.cfg.max_blocks_per_seq *
+                   self.engine.cfg.block_size)
+        if len(prompt) + mnt > max_ctx:
+            raise InvalidRequestError(
+                f"prompt ({len(prompt)}) + max_tokens ({mnt}) exceeds the "
+                f"replica's max context {max_ctx}")
+        if temperature is not None and temperature != self.cfg.temperature:
+            # one ragged batch shares one temperature; per-request values
+            # would silently cross-contaminate sampling
+            raise InvalidRequestError(
+                f"per-request temperature {temperature} != deployment "
+                f"temperature {self.cfg.temperature} (one continuous batch "
+                "shares one sampler)")
+        if deadline_s is None:
+            deadline_s = self.cfg.deadline_s
+        now = time.monotonic()
+        req = _Request(
+            rid=rid or f"req-{next(_rid_counter)}",
+            prompt=prompt, max_new_tokens=mnt,
+            stop_ids=frozenset(self.cfg.stop_token_ids) | frozenset(
+                int(t) for t in stop_token_ids),
+            deadline=None if deadline_s is None else now + deadline_s,
+            submit_ts=now)
+        with self._wake:
+            if self._stop or self._dead:
+                raise BrokerStoppedError(f"broker {self.name} not accepting")
+            if len(self._queue) >= self.cfg.max_queue:
+                self.metrics.record_reject()
+                raise QueueFullError(
+                    f"admission queue full ({self.cfg.max_queue})")
+            self.metrics.record_submit()
+            self._queue.append(req)
+            self._by_rid[req.rid] = req
+            self._wake.notify_all()
+        return RequestHandle(self, req)
+
+    def cancel(self, rid: str) -> bool:
+        with self._wake:
+            req = self._by_rid.get(rid)
+            if req is None or req.state in _TERMINAL:
+                return False
+            self._cancels.append(rid)
+            if self._thread is None or not self._thread.is_alive():
+                self._apply_cancels_locked()  # paused/dead broker
+            else:
+                self._wake.notify_all()
+        return True
+
+    # -- pool surface ----------------------------------------------------
+
+    def start(self) -> "RequestBroker":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name=f"dstpu-serving-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def healthy(self) -> bool:
+        return (self._dead is None and not self._stop and
+                (self._thread is None or self._thread.is_alive()))
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def outstanding(self) -> int:
+        """Live (non-terminal) requests."""
+        with self._lock:
+            return sum(1 for r in self._by_rid.values()
+                       if r.state not in _TERMINAL)
+
+    def outstanding_tokens(self) -> int:
+        """Routing weight: tokens of work still owed (prompt not yet
+        prefilled + generation budget not yet delivered)."""
+        with self._lock:
+            total = 0
+            for r in self._by_rid.values():
+                if r.state in _TERMINAL:
+                    continue
+                total += r.max_new_tokens - r.delivered
+                if r.state == RequestState.QUEUED:
+                    total += len(r.prompt)
+            return total
+
+    def kv_utilization(self) -> float:
+        e = self.engine
+        return 1.0 - e.free_blocks / max(e.total_blocks, 1)
+
+    def kill(self, reason: str = "replica_dead") -> None:
+        """Simulate/execute hard replica death: the engine thread exits and
+        every outstanding request fails with ``reason`` (the balancer
+        retries those on surviving replicas)."""
+        with self._wake:
+            self._dead = reason
+            self._wake.notify_all()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=30.0)
+        else:
+            with self._wake:
+                self._fail_all_locked(reason)
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        with self._wake:
+            self._stop = True
+            self._drain = drain
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # drain overran its window: hard-stop
+                with self._wake:
+                    self._dead = "shutdown"
+                    self._wake.notify_all()
+                self._thread.join(timeout=10.0)
+
+    # -- engine thread ---------------------------------------------------
+
+    def _finalize_locked(self, req: _Request, reason: str,
+                         detail: str = "") -> None:
+        req.finish_reason = reason
+        if reason in ("length", "stop"):
+            req.state = RequestState.DONE
+        elif reason == "cancelled":
+            req.state = RequestState.CANCELLED
+        else:
+            req.state = RequestState.FAILED
+            req.error = detail or reason
+        if reason in ("replica_dead", "engine_error", "shutdown"):
+            # infra failure, not a request disposition: the balancer retries
+            # these and records the final outcome (completed or error)
+            self.metrics.record_failover()
+        else:
+            self.metrics.record_finish(reason)
+        if req.uid is not None:
+            self._by_uid.pop(req.uid, None)
+        if req.state == RequestState.FAILED:
+            req.out_q.put(("err", (reason, detail or reason)))
+        else:
+            req.out_q.put(("done", reason))
+
+    def _apply_cancels_locked(self) -> None:
+        for rid in self._cancels:
+            req = self._by_rid.get(rid)
+            if req is None or req.state in _TERMINAL:
+                continue
+            if req.state == RequestState.QUEUED:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    pass
+            elif req.uid is not None:
+                self.engine.cancel(req.uid)
+            self._finalize_locked(req, "cancelled")
+        self._cancels.clear()
+
+    def _shed_deadlines_locked(self, now: float) -> None:
+        for req in list(self._by_rid.values()):
+            if req.state in _TERMINAL or req.deadline is None \
+                    or now < req.deadline:
+                continue
+            if req.state == RequestState.QUEUED:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    pass
+            elif req.uid is not None:
+                self.engine.cancel(req.uid)
+            self._finalize_locked(req, "deadline",
+                                  f"SLO deadline exceeded after "
+                                  f"{now - req.submit_ts:.3f}s")
+
+    def _admit_locked(self, now: float) -> None:
+        while self._queue:
+            req = self._queue[0]
+            try:
+                uid = self.engine.put(req.prompt, req.max_new_tokens,
+                                      strict=True)
+            except AdmissionError:
+                break  # defer: capacity frees as running requests finish
+            self._queue.popleft()
+            req.uid = uid
+            req.state = RequestState.PREFILL
+            self._by_uid[uid] = req
+            self.metrics.record_admit(now - req.submit_ts)
+
+    def _fail_all_locked(self, reason: str) -> None:
+        for req in list(self._by_rid.values()):
+            if req.state not in _TERMINAL:
+                self._finalize_locked(req, reason)
+        self._queue.clear()
+
+    def _reap_terminal_locked(self) -> None:
+        # keep the registry bounded in long-lived deployments
+        if len(self._by_rid) > 4 * self.cfg.max_queue:
+            for rid in [r.rid for r in self._by_rid.values()
+                        if r.state in _TERMINAL]:
+                del self._by_rid[rid]
+
+    def _dispatch(self, out: Dict[int, int], now: float) -> None:
+        for uid, tok in out.items():
+            with self._lock:
+                req = self._by_uid.get(uid)
+            if req is None:
+                continue
+            if tok in req.stop_ids:
+                with self._wake:
+                    self.engine.cancel(uid)
+                    self._finalize_locked(req, "stop")
+                continue
+            req.delivered += 1
+            if req.first_token_ts is None:
+                req.first_token_ts = now
+                req.state = RequestState.DECODE
+                self.metrics.record_first_token(now - req.submit_ts)
+            else:
+                self.metrics.record_token(now - req.last_token_ts)
+            req.last_token_ts = now
+            req.out_q.put(("tok", tok))
+            if uid not in self.engine.running:  # budget exhausted this step
+                with self._wake:
+                    self._finalize_locked(req, "length")
+
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._wake:
+                    if self._dead:
+                        self._fail_all_locked(self._dead)
+                        return
+                    now = time.monotonic()
+                    self._apply_cancels_locked()
+                    self._shed_deadlines_locked(now)
+                    if not (self._stop and not self._drain):
+                        self._admit_locked(now)
+                    self._reap_terminal_locked()
+                    has_work = bool(self.engine.running or
+                                    self.engine.waiting or self._queue)
+                    if self._stop and (not self._drain or not has_work):
+                        if not self._drain:
+                            self._fail_all_locked("shutdown")
+                        return
+                    if not has_work:
+                        if self._own_gauges:
+                            self.metrics.set_gauges(len(self._queue), 0,
+                                                    self.kv_utilization())
+                        self._wake.wait(self.cfg.idle_wait_s)
+                        continue
+                # JAX outside the lock: submit/cancel stay non-blocking
+                out = self.engine.step(temperature=self.cfg.temperature)
+                self._dispatch(out, time.monotonic())
+                if self._own_gauges:
+                    self.metrics.set_gauges(
+                        len(self._queue), self.engine.num_running,
+                        self.kv_utilization())
+        except Exception as e:  # engine fault → fail outstanding, die
+            logger.error(f"serving broker {self.name} engine fault: {e!r}")
+            with self._wake:
+                self._dead = f"engine_error: {e!r}"
+                self._fail_all_locked("engine_error")

@@ -1,0 +1,42 @@
+"""Serving-layer configuration.
+
+Capability analogue of DeepSpeed-MII's deployment config (``mii/config.py``
+``ModelConfig``/``MIIConfig``: replica counts, queue sizes, ports). A plain
+dataclass like :class:`inference.v2.engine.V2Config` — the serving layer sits
+outside the pydantic training-config tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    #: bounded admission queue PER REPLICA (requests accepted but not yet
+    #: admitted into the engine). Overflow raises QueueFullError → HTTP 429:
+    #: the SLO-backpressure knob — queue depth is the latency you promise.
+    max_queue: int = 64
+    #: applied when a request omits max_tokens
+    default_max_tokens: int = 64
+    #: engine-wide sampling temperature (one ragged batch shares one
+    #: temperature; per-request overrides must match — see broker docstring)
+    temperature: float = 0.0
+    #: per-request SLO deadline (seconds from submit to completion); None
+    #: disables shedding. Queued requests past deadline fail without ever
+    #: occupying KV; running ones are cancelled and their blocks freed.
+    deadline_s: Optional[float] = None
+    #: emitting any of these tokens ends the request (finish_reason "stop")
+    stop_token_ids: Tuple[int, ...] = ()
+    #: engine-thread idle wait between polls when there is no work
+    idle_wait_s: float = 0.005
+    #: replica pool size (in-process engine instances sharing params)
+    num_replicas: int = 1
+    #: transparent retries when a replica dies mid-request
+    retry_limit: int = 2
+    retry_backoff_s: float = 0.05
+    #: graceful-drain window on shutdown (SIGTERM → finish outstanding)
+    drain_timeout_s: float = 30.0
+    #: metrics pump: emit monitor Events every this many seconds
+    metrics_interval_s: float = 2.0

@@ -1,0 +1,153 @@
+"""Serving metrics: TTFT, TPOT, queue depth, KV utilization, goodput.
+
+Counters and latency reservoirs shared by every replica's broker (one
+instance per deployment, thread-safe), surfaced three ways:
+
+* ``to_events(step)`` — ``monitor.Event`` tuples for the CSV / TensorBoard /
+  wandb sinks (``deepspeed_tpu/monitor/monitor.py``), same pipeline the
+  training engine uses;
+* ``to_prometheus()`` — text exposition for the HTTP ``/metrics`` endpoint;
+* ``snapshot()`` — a plain dict (healthz, bench, tests).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List
+
+from ..monitor.monitor import Event, Monitor
+
+
+def _percentile(samples: List[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+class _Reservoir:
+    """Sliding window of the most recent N latency samples."""
+
+    def __init__(self, cap: int = 2048):
+        self._buf: Deque[float] = deque(maxlen=cap)
+
+    def add(self, x: float) -> None:
+        self._buf.append(x)
+
+    def percentiles(self) -> Dict[str, float]:
+        s = list(self._buf)
+        return {"p50": _percentile(s, 0.50), "p95": _percentile(s, 0.95),
+                "p99": _percentile(s, 0.99),
+                "mean": (sum(s) / len(s)) if s else 0.0,
+                "count": float(len(s))}
+
+
+class ServingMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ttft_ms = _Reservoir()   # submit → first generated token
+        self.tpot_ms = _Reservoir()   # inter-token gap during decode
+        self.queue_wait_ms = _Reservoir()  # submit → engine admission
+        # counters (monotonic)
+        self.submitted = 0
+        self.rejected = 0        # queue-cap backpressure (429)
+        self.completed = 0
+        self.cancelled = 0
+        self.failed = 0
+        self.deadline_missed = 0  # shed by SLO deadline
+        self.failovers = 0        # replica died mid-request; balancer retried
+        self.tokens_out = 0
+        # gauges (set by the pool's metrics pump / broker loop)
+        self.queue_depth = 0
+        self.running = 0
+        self.kv_utilization = 0.0
+        self._t0 = time.monotonic()
+
+    # -- recording hooks (broker/balancer/server) ----------------------
+
+    def record_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_admit(self, queue_wait_s: float) -> None:
+        with self._lock:
+            self.queue_wait_ms.add(queue_wait_s * 1e3)
+
+    def record_first_token(self, ttft_s: float) -> None:
+        with self._lock:
+            self.ttft_ms.add(ttft_s * 1e3)
+            self.tokens_out += 1
+
+    def record_token(self, gap_s: float) -> None:
+        with self._lock:
+            self.tpot_ms.add(gap_s * 1e3)
+            self.tokens_out += 1
+
+    def record_failover(self) -> None:
+        with self._lock:
+            self.failovers += 1
+
+    def record_finish(self, reason: str) -> None:
+        with self._lock:
+            if reason in ("length", "stop"):
+                self.completed += 1
+            elif reason == "cancelled":
+                self.cancelled += 1
+            elif reason == "deadline":
+                self.deadline_missed += 1
+                self.failed += 1
+            else:
+                self.failed += 1
+
+    def set_gauges(self, queue_depth: int, running: int,
+                   kv_utilization: float) -> None:
+        with self._lock:
+            self.queue_depth = queue_depth
+            self.running = running
+            self.kv_utilization = kv_utilization
+
+    # -- exposition ----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            out: Dict[str, float] = {
+                "submitted": self.submitted, "rejected": self.rejected,
+                "completed": self.completed, "cancelled": self.cancelled,
+                "failed": self.failed,
+                "deadline_missed": self.deadline_missed,
+                "failovers": self.failovers,
+                "tokens_out": self.tokens_out,
+                "queue_depth": self.queue_depth, "running": self.running,
+                "kv_utilization": self.kv_utilization,
+                # goodput: requests that completed within their SLO, per sec
+                "goodput_rps": self.completed / elapsed,
+                "tokens_per_s": self.tokens_out / elapsed,
+            }
+            for name, res in (("ttft_ms", self.ttft_ms),
+                              ("tpot_ms", self.tpot_ms),
+                              ("queue_wait_ms", self.queue_wait_ms)):
+                for k, v in res.percentiles().items():
+                    out[f"{name}_{k}"] = v
+            return out
+
+    def to_events(self, step: int) -> List[Event]:
+        return [(f"serving/{k}", float(v), step)
+                for k, v in self.snapshot().items()]
+
+    def to_prometheus(self) -> str:
+        lines = []
+        for k, v in self.snapshot().items():
+            lines.append(f"dstpu_serving_{k} {v}")
+        return "\n".join(lines) + "\n"
+
+    def emit_to(self, monitor: Monitor, step: int) -> None:
+        if monitor is not None and getattr(monitor, "enabled", False):
+            monitor.write_events(self.to_events(step))

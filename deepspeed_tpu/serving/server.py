@@ -1,0 +1,400 @@
+"""OpenAI-compatible HTTP front over the replica pool.
+
+Capability analogue of DeepSpeed-MII's RESTful API (``mii/grpc_related/
+restful_gateway.py``) — stdlib ``ThreadingHTTPServer`` (one thread per
+connection; every JAX call stays on the replicas' engine threads, so HTTP
+concurrency costs nothing on the accelerator side).
+
+Endpoints:
+
+* ``POST /v1/completions`` — OpenAI completions shape. ``prompt`` is a token
+  id list (the OpenAI API's array-of-tokens form) or a string through the
+  deployment's tokenizer (default: whitespace-separated integers, so the
+  tiny-model demo is curl-able without a tokenizer).  ``"stream": true``
+  streams SSE ``data:`` chunks over chunked transfer encoding; each chunk
+  carries the token id (``choices[0].token``) next to the text.
+* ``POST /v1/cancel`` — ``{"id": "..."}`` aborts an in-flight request (the
+  other cancel path is simply closing the streaming connection).
+* ``GET /healthz`` — replica health + pool state (503 when no replica).
+* ``GET /metrics`` — Prometheus text exposition of the serving metrics.
+
+Backpressure: when every healthy replica's bounded admission queue is full,
+``/v1/completions`` returns **429** with ``Retry-After`` instead of queueing
+unboundedly — queue depth is the tail-latency SLO knob (`ServingConfig.
+max_queue`); deadline-shed requests return 504.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+from ..utils.proc import terminate_procs
+from .balancer import BalancedHandle, NoReplicaError, ReplicaPool
+from .broker import InvalidRequestError, QueueFullError, RequestFailedError
+from .config import ServingConfig
+from .metrics import ServingMetrics
+
+
+def _default_encode(text: str) -> List[int]:
+    try:
+        return [int(t) for t in text.split()]
+    except ValueError:
+        raise InvalidRequestError(
+            "no tokenizer configured: string prompts must be "
+            "whitespace-separated token ids (or pass a token id array)")
+
+
+def _default_decode(tokens: Sequence[int]) -> str:
+    return "".join(f" {t}" for t in tokens)
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # conservative: finish the TCP handshake fast even under thread churn
+    request_queue_size = 64
+
+    def __init__(self, addr, pool: ReplicaPool, metrics: ServingMetrics,
+                 config: ServingConfig, model_name: str = "deepspeed_tpu",
+                 encode: Optional[Callable[[str], List[int]]] = None,
+                 decode: Optional[Callable[[Sequence[int]], str]] = None):
+        super().__init__(addr, _Handler)
+        self.pool = pool
+        self.metrics = metrics
+        self.cfg = config
+        self.model_name = model_name
+        self.encode = encode or _default_encode
+        self.decode = decode or _default_decode
+        self._handles = {}  # rid -> BalancedHandle (live requests)
+        self._handles_lock = threading.Lock()
+
+    def handle_error(self, request, client_address):  # noqa: N802
+        import sys as _sys
+
+        exc = _sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return  # clients abandoning connections is normal in serving
+        super().handle_error(request, client_address)
+
+    def register(self, handle: BalancedHandle) -> None:
+        with self._handles_lock:
+            self._handles[handle.rid] = handle
+
+    def unregister(self, rid: str) -> None:
+        with self._handles_lock:
+            self._handles.pop(rid, None)
+
+    def cancel_rid(self, rid: str) -> bool:
+        with self._handles_lock:
+            handle = self._handles.get(rid)
+        if handle is None:
+            return False
+        handle.cancel()
+        return True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: ServingHTTPServer  # type: ignore[assignment]
+
+    def log_message(self, fmt, *args):  # quiet: route to framework logger
+        logger.debug("serving http: " + fmt % args)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _json(self, code: int, obj: dict,
+              headers: Sequence[Tuple[str, str]] = ()) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str, etype: str,
+               headers: Sequence[Tuple[str, str]] = ()) -> None:
+        self._json(code, {"error": {"message": message, "type": etype,
+                                    "code": code}}, headers)
+
+    def _read_body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n) if n else b"{}"
+        try:
+            body = json.loads(raw or b"{}")
+        except json.JSONDecodeError as e:
+            raise InvalidRequestError(f"invalid JSON body: {e}")
+        if not isinstance(body, dict):
+            raise InvalidRequestError("body must be a JSON object")
+        return body
+
+    def _chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    # -- routes ----------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (stdlib casing)
+        if self.path == "/healthz":
+            health = self.server.pool.health()
+            health["metrics"] = self.server.metrics.snapshot()
+            self._json(200 if health["status"] == "ok" else 503, health)
+        elif self.path == "/metrics":
+            body = self.server.metrics.to_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._error(404, f"no route {self.path}", "not_found")
+
+    def do_POST(self):  # noqa: N802
+        try:
+            if self.path == "/v1/completions":
+                self._completions()
+            elif self.path == "/v1/cancel":
+                body = self._read_body()
+                ok = self.server.cancel_rid(str(body.get("id", "")))
+                self._json(200 if ok else 404,
+                           {"id": body.get("id"), "cancelled": ok})
+            else:
+                self._error(404, f"no route {self.path}", "not_found")
+        except InvalidRequestError as e:
+            self._error(400, str(e), "invalid_request_error")
+        except QueueFullError as e:
+            self.server.metrics.record_reject()
+            self._error(429, str(e), "overloaded",
+                        headers=[("Retry-After", "1")])
+        except NoReplicaError as e:
+            self._error(503, str(e), "service_unavailable")
+
+    def _parse_prompt(self, body: dict) -> List[int]:
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            return self.server.encode(prompt)
+        if isinstance(prompt, list) and all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in prompt):
+            return list(prompt)
+        raise InvalidRequestError(
+            "prompt must be a string or a token id array")
+
+    def _completions(self) -> None:
+        body = self._read_body()
+        if body.get("n", 1) != 1:
+            raise InvalidRequestError("only n=1 is supported")
+        prompt = self._parse_prompt(body)
+        kwargs = dict(
+            max_new_tokens=body.get("max_tokens"),
+            temperature=body.get("temperature"),
+            deadline_s=body.get("deadline_s"),
+            stop_token_ids=body.get("stop_token_ids", ()),
+        )
+        handle = self.server.pool.submit(prompt, **kwargs)
+        self.server.register(handle)
+        try:
+            if body.get("stream"):
+                self._stream_response(handle)
+            else:
+                self._unary_response(handle)
+        finally:
+            self.server.unregister(handle.rid)
+
+    def _completion_obj(self, handle: BalancedHandle, text: str,
+                        finish_reason, *, chunk: bool, token=None) -> dict:
+        choice = {"index": 0, "text": text, "logprobs": None,
+                  "finish_reason": finish_reason}
+        if token is not None:
+            choice["token"] = token
+        return {"id": f"cmpl-{handle.rid}",
+                "object": "text_completion" + (".chunk" if chunk else ""),
+                "created": int(time.time()),
+                "model": self.server.model_name,
+                "choices": [choice]}
+
+    def _unary_response(self, handle: BalancedHandle) -> None:
+        try:
+            tokens = handle.result()
+        except RequestFailedError as e:
+            if e.reason == "deadline":
+                self._error(504, str(e), "deadline_exceeded")
+            else:
+                self._error(503, f"request failed: {e}", "service_unavailable")
+            return
+        obj = self._completion_obj(handle, self.server.decode(tokens),
+                                   handle.finish_reason, chunk=False)
+        obj["choices"][0]["tokens"] = tokens
+        obj["usage"] = {"prompt_tokens": len(handle.prompt),
+                        "completion_tokens": len(tokens),
+                        "total_tokens": len(handle.prompt) + len(tokens)}
+        self._json(200, obj)
+
+    def _stream_response(self, handle: BalancedHandle) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def sse(obj) -> bytes:
+            return b"data: " + json.dumps(obj).encode() + b"\n\n"
+
+        try:
+            try:
+                for tok in handle.tokens():
+                    self._chunk(sse(self._completion_obj(
+                        handle, self.server.decode([tok]), None,
+                        chunk=True, token=tok)))
+                final = self._completion_obj(handle, "",
+                                             handle.finish_reason or "length",
+                                             chunk=True)
+            except RequestFailedError as e:
+                final = self._completion_obj(handle, "", "error", chunk=True)
+                final["error"] = {"message": str(e), "type": e.reason}
+            self._chunk(sse(final))
+            self._chunk(b"data: [DONE]\n\n")
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client went away mid-stream: the disconnect IS the cancel
+            handle.cancel()
+            self.close_connection = True
+
+
+def create_server(pool: ReplicaPool, metrics: ServingMetrics,
+                  config: ServingConfig, host: str = "127.0.0.1",
+                  port: int = 0, **kwargs) -> ServingHTTPServer:
+    return ServingHTTPServer((host, port), pool, metrics, config, **kwargs)
+
+
+# -- deployment entrypoint -------------------------------------------------
+
+
+def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
+                                         ServingConfig]:
+    import jax
+
+    from ..inference.v2.engine import InferenceEngineV2, V2Config
+    from ..models import transformer as tfm
+
+    model_cfg = tfm.get_config(args.model, dtype=args.dtype)
+    params = tfm.init_params(jax.random.PRNGKey(args.seed), model_cfg)
+    v2 = V2Config(max_tokens_per_step=args.max_tokens_per_step,
+                  max_seqs=args.max_seqs, block_size=args.block_size,
+                  num_blocks=args.num_blocks,
+                  max_blocks_per_seq=args.max_blocks_per_seq,
+                  dtype=args.dtype)
+    cfg = ServingConfig(max_queue=args.max_queue,
+                        default_max_tokens=args.default_max_tokens,
+                        temperature=args.temperature,
+                        deadline_s=args.deadline_s,
+                        num_replicas=args.replicas)
+    monitor = None
+    if args.csv_dir:
+        from ..monitor.monitor import CSVMonitor
+
+        monitor = CSVMonitor(args.csv_dir, job_name="serving")
+    metrics = ServingMetrics()
+    pool = ReplicaPool.build(lambda: InferenceEngineV2(model_cfg, params, v2),
+                             cfg, metrics=metrics, monitor=monitor)
+    return pool, metrics, cfg
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="dstpu-serve",
+                                description="deepspeed_tpu serving front")
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--default_max_tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--deadline_s", type=float, default=None)
+    p.add_argument("--max_tokens_per_step", type=int, default=64)
+    p.add_argument("--max_seqs", type=int, default=8)
+    p.add_argument("--block_size", type=int, default=16)
+    p.add_argument("--num_blocks", type=int, default=256)
+    p.add_argument("--max_blocks_per_seq", type=int, default=16)
+    p.add_argument("--csv_dir", default=None,
+                   help="emit serving metrics to a CSVMonitor at this path")
+    args = p.parse_args(argv)
+
+    pool, metrics, cfg = _build_pool_from_args(args)
+    pool.start()
+    server = create_server(pool, metrics, cfg, host=args.host, port=args.port,
+                           model_name=args.model)
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        logger.info("serving: signal %s — draining" % signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    # the subprocess launcher greps for this line to learn the bound port
+    print(f"dstpu-serving listening on http://{args.host}:"
+          f"{server.server_port}", flush=True)
+    stop.wait()
+    pool.drain(cfg.drain_timeout_s)
+    server.shutdown()
+    return 0
+
+
+def launch_server_subprocess(argv: Sequence[str], timeout_s: float = 120.0,
+                             env: Optional[dict] = None
+                             ) -> Tuple[subprocess.Popen, str]:
+    """Spawn ``python -m deepspeed_tpu.serving.server <argv>`` and wait for
+    its ready line; returns (proc, base_url).  Pair with ``stop_server``."""
+    import os
+
+    full_env = dict(os.environ)
+    full_env.update(env or {})
+    # the child must import deepspeed_tpu regardless of the caller's cwd
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    prev = full_env.get("PYTHONPATH")
+    full_env["PYTHONPATH"] = (pkg_root + os.pathsep + prev) if prev \
+        else pkg_root
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepspeed_tpu.serving.server", *argv],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=full_env)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"serving subprocess exited rc={proc.returncode}")
+            continue
+        if "dstpu-serving listening on " in line:
+            return proc, line.split("listening on ", 1)[1].strip()
+    terminate_procs([proc], term_timeout_s=5.0)
+    raise TimeoutError("serving subprocess never became ready")
+
+
+def stop_server(proc: subprocess.Popen, term_timeout_s: float = 15.0) -> int:
+    """Graceful stop: SIGTERM triggers the drain path; SIGKILL after the
+    grace period (shared ``terminate_procs`` policy with the elastic
+    agent)."""
+    return terminate_procs([proc], term_timeout_s=term_timeout_s)[0]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

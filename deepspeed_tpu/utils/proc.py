@@ -1,0 +1,40 @@
+"""Process-group teardown shared by elasticity and serving.
+
+One grace-period policy for every place the framework kills a process
+group: the elastic agent tearing down a worker generation
+(``elasticity/elastic_agent.py``), the serving demo/bench stopping an HTTP
+front, and any launcher-spawned helper. SIGTERM first so workers can flush
+checkpoints / drain in-flight requests, SIGKILL whatever is still alive
+after the grace period.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import time
+from typing import List, Optional, Sequence
+
+
+def terminate_procs(procs: Sequence[subprocess.Popen],
+                    term_timeout_s: float = 10.0,
+                    poll_interval_s: float = 0.05) -> List[Optional[int]]:
+    """SIGTERM every live process, give the group ``term_timeout_s`` to exit,
+    SIGKILL the survivors.  Returns the final return codes (same order as
+    ``procs``; every entry is non-None on return)."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:  # already reaped by the OS
+                pass
+    deadline = time.monotonic() + term_timeout_s
+    for p in procs:
+        while p.poll() is None and time.monotonic() < deadline:
+            time.sleep(poll_interval_s)
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait()
+    return [p.poll() for p in procs]

@@ -33,13 +33,24 @@ then
     exit 2
 fi
 
+# same for the serving suite — its imports pull in the whole stack
+# (inference/v2, elasticity teardown helper, monitor, HTTP front)
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_serving.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_serving.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi
 
 echo "== t1: full suite =="
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu \
+timeout -k 10 1800 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
