@@ -54,6 +54,18 @@ class AgentConfig:
     #: rejoin; keeps a single crash from burning two restarts (one to drop
     #: the member, one membership-change to re-admit it a poll later)
     rejoin_cooldown_s: float = 30.0
+    #: checkpoint directory the workers save into.  When set, the agent
+    #: VALIDATES checkpoints (manifest existence/size/digest) before every
+    #: group (re)launch and exports the newest valid tag to workers as
+    #: DSTPU_RESUME_TAG — a corrupt latest save must not become a
+    #: permanent relaunch-crash loop.
+    checkpoint_dir: Optional[str] = None
+    #: backoff before a RElaunch when checkpoints exist but none validate:
+    #: the group would restart from scratch (or crash again immediately),
+    #: so pace the loop instead of burning max_restarts in seconds.
+    #: Exponential in the restart count, capped at restart_backoff_max_s.
+    restart_backoff_s: float = 5.0
+    restart_backoff_max_s: float = 60.0
 
 
 class ElasticAgent:
@@ -124,11 +136,46 @@ class ElasticAgent:
                       ) -> subprocess.Popen:
         import os
 
+        from ..utils import faults
+
+        faults.maybe_fail("elastic.launch")
         full = dict(os.environ)
         full.update(env)
         return subprocess.Popen(self.program, env=full)
 
+    # -- checkpoint validation (pre-relaunch) ---------------------------
+
+    def _resume_env(self) -> Dict[str, str]:
+        """Validate the checkpoint directory and pick the resume tag for the
+        next generation.  Exports DSTPU_RESUME_TAG so every worker resumes
+        from the SAME validated tag (workers independently reading `latest`
+        could disagree mid-save, or all land on a corrupt dir).  When tags
+        exist but none validate, applies the restart backoff — relaunching
+        a crash-looping group at poll speed helps nobody."""
+        if not self.cfg.checkpoint_dir:
+            return {}
+        from ..runtime.checkpoint.engine import (checkpoint_candidates,
+                                                 find_latest_valid_checkpoint)
+
+        ckpt_dir = self.cfg.checkpoint_dir
+        tag = find_latest_valid_checkpoint(ckpt_dir)
+        if tag is not None:
+            logger.info(f"elastic agent: validated resume checkpoint "
+                        f"{ckpt_dir}/{tag}")
+            return {"DSTPU_RESUME_TAG": tag}
+        if checkpoint_candidates(ckpt_dir):
+            logger.error(
+                f"elastic agent: checkpoints exist under {ckpt_dir} but NONE "
+                "validate — workers start fresh; backing off before launch")
+            if self.restart_count > 0:
+                delay = min(
+                    self.cfg.restart_backoff_s * 2 ** (self.restart_count - 1),
+                    self.cfg.restart_backoff_max_s)
+                time.sleep(delay)
+        return {}
+
     def _start_group(self, members: List[str]) -> None:
+        resume_env = self._resume_env()
         coordinator = members[0]
         n = len(members)
         # rotate the coordinator port per generation: the previous
@@ -146,6 +193,7 @@ class ElasticAgent:
                 "DSTPU_RESTART_COUNT": str(self.restart_count),
                 "DSTPU_ELASTIC_MEMBER": member,
             })
+            env.update(resume_env)
             self.procs.append(self.launch_fn(member, env))
         self.current_members = list(members)
         logger.info(f"elastic agent: started {n} workers "

@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import faults
 from ..utils.logging import warning_once
 from ..utils.tree_io import flatten_with_paths, start_d2h, to_host_arrays
 
@@ -187,6 +188,7 @@ class FastFileWriter:
         unable to drain the in-flight requests before closing the fd.
         Segment size spreads the payload over the pool but never drops
         below 8 MiB (tiny segments = syscall overhead, not parallelism)."""
+        faults.maybe_fail("io.fast.submit")
         h = self._aio
         out_reqs.append(h.fd_pwrite(fd, np.frombuffer(header, np.uint8),
                                     len(header), 0))
@@ -209,6 +211,7 @@ class FastFileWriter:
         requests are still drained BEFORE any fd closes — pool threads
         writing through a closed (and possibly reused) fd would corrupt
         whatever file the kernel hands that number to next."""
+        faults.maybe_fail("io.fast.drain")
         err: Optional[BaseException] = None
         for r in reqs:
             try:
@@ -325,6 +328,7 @@ class FastFileWriter:
         files' chunk writes share the AIO pool and a single drain.  On a
         bandwidth-bound disk this overlaps each file's writeback with the
         others' (IO_BENCH.md: 1.25x durable)."""
+        faults.maybe_fail("io.fast.submit")
         flats = [(flatten_with_paths(tree), path)
                  for tree, path in trees_and_paths]
         start_d2h([leaf for flat, _ in flats for leaf in flat.values()])

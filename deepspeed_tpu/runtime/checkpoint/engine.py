@@ -12,24 +12,259 @@ time via ``device_put`` with the target sharding.
 
 Backends: ``native`` (safetensors files + msgpack metadata, async-capable)
 and ``orbax`` (for multi-host pods, reference's Nebula/DataStates role).
+
+Durability (reference: decoupled/Nebula/DataStates checkpoint engines —
+CheckFreq-style async saving is only safe when commit is atomic and load
+can fall back):
+
+* saves stage into ``<tag>.tmp/``, emit a ``manifest.json`` (per-file size
+  + digest + the engine meta), fsync every file and the parent directory,
+  then commit with a single ``os.replace`` rename — a crash at ANY point
+  leaves either the previous committed state or an uncommitted ``.tmp``
+  that the next save garbage-collects;
+* the ``latest`` pointer is updated write-temp-then-rename, after commit;
+* ``verify_checkpoint`` checks a directory against its manifest;
+  ``load_checkpoint(..., fallback=True)`` walks tags newest→oldest to the
+  newest committed-and-valid checkpoint instead of dying on the first
+  corrupt one; the elastic agent validates with
+  ``find_latest_valid_checkpoint`` before every group relaunch;
+* async-save failures are recorded per thread and re-raised from
+  ``wait_for_async_saves()`` / the next ``save_checkpoint`` — never
+  swallowed.
+
+Fault sites (``utils/faults.py``): ``ckpt.write.model``,
+``ckpt.write.optimizer``, ``ckpt.write.meta``, ``ckpt.write.manifest``,
+``ckpt.commit``, ``ckpt.latest``; torn-write sites ``ckpt.truncate.model``
+/ ``ckpt.truncate.optimizer``.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import shutil
 import threading
-from typing import Any, Dict, Optional, Tuple
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...utils import faults
 from ...utils.logging import log_dist, logger
 
 _LATEST = "latest"
-_SAVE_LOCK = threading.Lock()
+_MANIFEST = "manifest.json"
+_TMP_SUFFIX = ".tmp"
+# RLock: _prune_old and the GC take it too, and are called from _do_save
+# which already holds it
+_SAVE_LOCK = threading.RLock()
 _async_threads = []
+#: (ckpt_dir, exception) per failed async save — drained by
+#: _raise_pending_async_errors (next save / wait_for_async_saves)
+_async_errors: List[Tuple[str, BaseException]] = []
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint failed manifest verification (or no valid checkpoint
+    exists where one was expected)."""
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _digest_file(path: str, algorithm: str) -> str:
+    if algorithm == "crc32":
+        crc = 0
+        with open(path, "rb") as f:
+            while chunk := f.read(1 << 20):
+                crc = zlib.crc32(chunk, crc)
+        return f"{crc & 0xFFFFFFFF:08x}"
+    if algorithm == "sha256":
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            while chunk := f.read(1 << 20):
+                h.update(chunk)
+        return h.hexdigest()
+    raise ValueError(f"unknown integrity algorithm {algorithm!r} "
+                     "(want none|crc32|sha256)")
+
+
+def _write_manifest(ckpt_dir: str, meta: Dict, algorithm: str) -> None:
+    """Size+digest every file in ``ckpt_dir``, fsync them, write the
+    manifest (fsync'd), fsync the directory.  Digests are computed by
+    reading the files BACK from the filesystem, so a write the kernel
+    mangled before this point is caught at the next verify."""
+    files: Dict[str, Dict[str, Any]] = {}
+    for name in sorted(os.listdir(ckpt_dir)):
+        if name == _MANIFEST:
+            continue
+        path = os.path.join(ckpt_dir, name)
+        entry: Dict[str, Any] = {"size": os.path.getsize(path)}
+        if algorithm != "none":
+            entry["digest"] = _digest_file(path, algorithm)
+        files[name] = entry
+        _fsync_path(path)
+    manifest = {"format_version": 1, "digest": algorithm,
+                "files": files, "meta": meta}
+    path = os.path.join(ckpt_dir, _MANIFEST)
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_path(ckpt_dir)
+
+
+def _write_latest(save_dir: str, tag: str) -> None:
+    """Update the ``latest`` pointer atomically (write-temp-then-rename):
+    a crash mid-update leaves the previous pointer, never a torn file."""
+    tmp = os.path.join(save_dir, _LATEST + _TMP_SUFFIX)
+    with open(tmp, "w") as f:
+        f.write(tag)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(save_dir, _LATEST))
+    _fsync_path(save_dir)
+
+
+def _commit_dir(tmp_dir: str, final_dir: str) -> None:
+    """Atomic commit: one rename.  An existing committed dir under the same
+    tag (re-save) is removed first — a crash inside that window leaves no
+    dir for this tag, which the fallback walk handles like any other
+    missing tag."""
+    if os.path.lexists(final_dir):
+        logger.warning(f"overwriting existing checkpoint {final_dir}")
+        shutil.rmtree(final_dir, ignore_errors=True)
+    os.replace(tmp_dir, final_dir)
+    _fsync_path(os.path.dirname(final_dir) or ".")
+
+
+def _gc_stale_tmp(save_dir: str, current: Optional[str] = None) -> None:
+    """Remove uncommitted ``*.tmp`` leftovers from crashed saves.  Called
+    under _SAVE_LOCK, so any tmp entry other than ``current`` (this save's
+    own staging dir) is by definition orphaned."""
+    try:
+        names = os.listdir(save_dir)
+    except FileNotFoundError:
+        return
+    for name in names:
+        if not name.endswith(_TMP_SUFFIX) or name == current:
+            continue
+        path = os.path.join(save_dir, name)
+        logger.warning(f"garbage-collecting uncommitted checkpoint leftover "
+                       f"{path}")
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+def is_committed(ckpt_dir: str) -> bool:
+    """A checkpoint directory is committed iff it was renamed into place,
+    i.e. it is not a ``.tmp`` staging dir and carries a manifest (legacy
+    pre-manifest checkpoints: engine_state.json marks a completed save)."""
+    if ckpt_dir.rstrip(os.sep).endswith(_TMP_SUFFIX):
+        return False
+    return (os.path.exists(os.path.join(ckpt_dir, _MANIFEST))
+            or os.path.exists(os.path.join(ckpt_dir, "engine_state.json")))
+
+
+def verify_checkpoint(ckpt_dir: str, check_digests: bool = True) -> List[str]:
+    """Check a checkpoint directory against its manifest.  Returns a list
+    of problems — empty means valid.  A missing manifest is reported as
+    ``"missing manifest.json"`` (uncommitted, or written by a pre-manifest
+    version — callers decide whether legacy counts)."""
+    if not os.path.isdir(ckpt_dir):
+        return [f"not a directory: {ckpt_dir}"]
+    problems: List[str] = []
+    if ckpt_dir.rstrip(os.sep).endswith(_TMP_SUFFIX):
+        problems.append("uncommitted (.tmp) staging directory")
+    path = os.path.join(ckpt_dir, _MANIFEST)
+    if not os.path.exists(path):
+        return problems + ["missing manifest.json"]
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+        algorithm = manifest.get("digest", "none")
+    except (OSError, ValueError, KeyError) as e:
+        return problems + [f"unreadable manifest.json: {e!r}"]
+    for name, entry in files.items():
+        fpath = os.path.join(ckpt_dir, name)
+        if not os.path.exists(fpath):
+            problems.append(f"{name}: missing")
+            continue
+        size = os.path.getsize(fpath)
+        if size != entry.get("size"):
+            problems.append(f"{name}: size {size} != manifest "
+                            f"{entry.get('size')}")
+            continue
+        if check_digests and algorithm != "none" and "digest" in entry:
+            digest = _digest_file(fpath, algorithm)
+            if digest != entry["digest"]:
+                problems.append(f"{name}: {algorithm} digest mismatch")
+    return problems
+
+
+def _is_legacy_only(problems: List[str]) -> bool:
+    return problems == ["missing manifest.json"]
+
+
+def checkpoint_candidates(load_dir: str) -> List[str]:
+    """Committed tags, newest first: ``global_step<N>`` tags ordered by N,
+    then any custom tags ordered by directory mtime.  Uncommitted ``.tmp``
+    staging dirs never appear."""
+    try:
+        names = os.listdir(load_dir)
+    except FileNotFoundError:
+        return []
+    steps, custom = [], []
+    for name in names:
+        path = os.path.join(load_dir, name)
+        if (name.endswith(_TMP_SUFFIX) or not os.path.isdir(path)
+                or not is_committed(path)):
+            continue
+        if name.startswith("global_step"):
+            try:
+                steps.append((int(name.removeprefix("global_step")), name))
+                continue
+            except ValueError:
+                pass
+        try:
+            custom.append((os.path.getmtime(path), name))
+        except OSError:
+            continue
+    return ([name for _, name in sorted(steps, reverse=True)]
+            + [name for _, name in sorted(custom, reverse=True)])
+
+
+def find_latest_valid_checkpoint(load_dir: str, check_digests: bool = True,
+                                 allow_legacy: bool = True
+                                 ) -> Optional[str]:
+    """Newest committed tag that passes verification (the elastic agent's
+    pre-relaunch validation; also the fallback walk's core).  Returns the
+    tag, or None when nothing valid exists."""
+    for tag in checkpoint_candidates(load_dir):
+        problems = verify_checkpoint(os.path.join(load_dir, tag),
+                                     check_digests=check_digests)
+        if not problems:
+            return tag
+        if _is_legacy_only(problems) and allow_legacy:
+            logger.warning(f"checkpoint {tag} predates manifests — accepted "
+                           "unverified")
+            return tag
+        logger.error(f"checkpoint {tag} failed verification: {problems}")
+    return None
 
 
 from ...utils.tree_io import flatten_with_paths as _flatten_with_paths  # noqa: E402
@@ -90,8 +325,16 @@ def _unflatten_like(template: Any, flat: Dict[str, np.ndarray]) -> Any:
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict] = None) -> str:
     """Write model+optimizer+engine state. Only process 0 writes in the
-    single-controller case; multi-host uses the orbax backend."""
+    single-controller case; multi-host uses the orbax backend.
+
+    Commit protocol: everything stages into ``<tag>.tmp/``; the manifest
+    is written and fsync'd last inside the staging dir; one ``os.replace``
+    makes the checkpoint visible.  A kill at any instant leaves either a
+    committed-and-valid tag or an orphaned ``.tmp`` (GC'd by the next
+    save) — never a committed-but-invalid tag."""
     cfg = engine.config.checkpoint
+    _raise_pending_async_errors()  # a silent prior failure must not let
+    # callers believe they have more durable checkpoints than they do
     tag = tag or f"global_step{int(engine.state.step)}"
     ckpt_dir = os.path.join(save_dir, tag)
 
@@ -144,14 +387,16 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         host_onebit = _full_host_tree({"worker": engine._onebit_wres,
                                        "server": engine._onebit_sres})
 
+    tmp_dir = ckpt_dir + _TMP_SUFFIX
+
     def _write_trees():
         model_path = os.path.join(
-            ckpt_dir, "adapter_model.safetensors" if peft
+            tmp_dir, "adapter_model.safetensors" if peft
             else "model.safetensors")
-        opt_path = os.path.join(ckpt_dir, "optimizer.safetensors")
+        opt_path = os.path.join(tmp_dir, "optimizer.safetensors")
         if host_onebit is not None:
             _save_tree(host_onebit,
-                       os.path.join(ckpt_dir, "onebit_residuals.safetensors"))
+                       os.path.join(tmp_dir, "onebit_residuals.safetensors"))
         if cfg.engine == "fast":
             # FastPersist (reference: fast_checkpoint_engine.py + io/
             # fast_file_writer.py): same on-disk safetensors layout, written
@@ -159,22 +404,37 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             # together — the loader is unchanged
             from ...io.fast_writer import get_fast_writer
 
+            faults.maybe_fail("ckpt.write.model")
             get_fast_writer().save_trees(
                 [(host_params, model_path), (host_opt, opt_path)])
         else:
+            faults.maybe_fail("ckpt.write.model")
             _save_tree(host_params, model_path)
+            faults.maybe_fail("ckpt.write.optimizer")
             _save_tree(host_opt, opt_path)
+        faults.maybe_truncate("ckpt.truncate.model", model_path)
+        faults.maybe_truncate("ckpt.truncate.optimizer", opt_path)
 
     def _do_save():
         with _SAVE_LOCK:
-            os.makedirs(ckpt_dir, exist_ok=True)
+            # leftovers from crashed saves; our own stale staging dir too
+            # (a previous kill between mkdir and commit under the same tag)
+            _gc_stale_tmp(save_dir, current=None)
+            os.makedirs(tmp_dir, exist_ok=True)
             _write_trees()
-            with open(os.path.join(ckpt_dir, "engine_state.json"), "w") as f:
+            faults.maybe_fail("ckpt.write.meta")
+            with open(os.path.join(tmp_dir, "engine_state.json"), "w") as f:
                 json.dump(meta, f, indent=2)
-            with open(os.path.join(save_dir, _LATEST), "w") as f:
-                f.write(tag)
+                f.flush()
+                os.fsync(f.fileno())
+            faults.maybe_fail("ckpt.write.manifest")
+            _write_manifest(tmp_dir, meta, cfg.integrity)
+            faults.maybe_fail("ckpt.commit")
+            _commit_dir(tmp_dir, ckpt_dir)
+            faults.maybe_fail("ckpt.latest")
+            _write_latest(save_dir, tag)
             log_dist(f"saved checkpoint {ckpt_dir}")
-            _prune_old(save_dir, cfg.keep_n_latest)
+            _prune_old(save_dir, cfg.keep_n_latest, latest_tag=tag)
 
     # only process 0 writes; EVERY process reaches the barrier below (a
     # rank-gated barrier would deadlock process 0)
@@ -182,8 +442,19 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         if cfg.async_save:
             # decoupled checkpoint engine (reference:
             # decoupled_checkpoint_engine.py): the host snapshot is complete,
-            # only file IO runs off-thread.
-            t = threading.Thread(target=_do_save, daemon=False)
+            # only file IO runs off-thread.  Failures are RECORDED, not
+            # swallowed — wait_for_async_saves() / the next save re-raise.
+            def _runner():
+                try:
+                    _do_save()
+                except BaseException as e:  # noqa: BLE001 — must not vanish
+                    logger.error(
+                        f"ASYNC CHECKPOINT SAVE FAILED ({ckpt_dir}): {e!r} — "
+                        "this checkpoint does NOT exist on disk; the error "
+                        "re-raises at wait_for_async_saves() / next save")
+                    _async_errors.append((ckpt_dir, e))
+
+            t = threading.Thread(target=_runner, daemon=False)
             t.start()
             _async_threads.append(t)
         else:
@@ -197,32 +468,99 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     return ckpt_dir
 
 
+def _raise_pending_async_errors() -> None:
+    if not _async_errors:
+        return
+    errors = list(_async_errors)
+    _async_errors.clear()
+    for ckpt, err in errors[1:]:
+        logger.error(f"additional async checkpoint failure ({ckpt}): {err!r}")
+    raise errors[0][1]
+
+
 def wait_for_async_saves() -> None:
+    """Join every in-flight async save and re-raise the first failure —
+    call before relying on a checkpoint's existence (end of run, eval
+    gates, pre-emption handlers)."""
     for t in _async_threads:
         t.join()
     _async_threads.clear()
+    _raise_pending_async_errors()
+
+
+def _atexit_drain() -> None:
+    # atexit must not raise; but it must NOT exit clean-and-silent either —
+    # an operator reading the tail of the log has to see the data loss
+    for t in _async_threads:
+        t.join()
+    _async_threads.clear()
+    if _async_errors:
+        import sys
+
+        for ckpt, err in _async_errors:
+            msg = (f"CHECKPOINT DATA LOSS: async save of {ckpt} failed "
+                   f"({err!r}) and the process exited before "
+                   "wait_for_async_saves() could re-raise it")
+            logger.error(msg)
+            print(msg, file=sys.stderr, flush=True)
 
 
 import atexit  # noqa: E402  (registration kept beside the definition)
 
-atexit.register(wait_for_async_saves)
+atexit.register(_atexit_drain)
 
 
-def _prune_old(save_dir: str, keep: Optional[int]) -> None:
+def _prune_old(save_dir: str, keep: Optional[int],
+               latest_tag: Optional[str] = None) -> None:
+    """Delete the oldest committed ``global_step`` tags beyond ``keep``.
+    Only COMMITTED tags are candidates — an in-flight async save's ``.tmp``
+    staging dir (or a tag mid-commit) is never touched — and the ``latest``
+    pointer's target survives even when saves land out of step order."""
     if not keep:
         return
-    tags = sorted(
-        (d for d in os.listdir(save_dir)
-         if os.path.isdir(os.path.join(save_dir, d)) and d.startswith("global_step")),
-        key=lambda d: int(d.removeprefix("global_step")))
-    for d in tags[:-keep]:
-        import shutil
+    with _SAVE_LOCK:
+        if latest_tag is None:
+            try:
+                latest_tag = open(os.path.join(save_dir, _LATEST)).read().strip()
+            except OSError:
+                latest_tag = None
+        tags = []
+        for d in os.listdir(save_dir):
+            path = os.path.join(save_dir, d)
+            if (d.endswith(_TMP_SUFFIX) or not d.startswith("global_step")
+                    or not os.path.isdir(path) or not is_committed(path)):
+                continue
+            try:
+                tags.append((int(d.removeprefix("global_step")), d))
+            except ValueError:
+                continue
+        for _, d in sorted(tags)[:-keep]:
+            if d == latest_tag:
+                continue
+            shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
 
-        shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
+
+try:
+    from safetensors import SafetensorError as _SafetensorError
+except Exception:  # very old safetensors: no public error class
+    class _SafetensorError(Exception):
+        """Placeholder — never raised."""
+
+
+#: load failures that mean "this checkpoint is damaged", safe to walk past
+#: under fallback.  Deliberate ValueErrors (optimizer-structure mismatch,
+#: adapter-only into a non-PEFT engine) and KeyErrors (tensor-tree mismatch,
+#: e.g. a full checkpoint offered to a PEFT engine) are NOT here: those are
+#: config errors the user must see, not corruption — crash damage surfaces
+#: as I/O or deserialization failures since engine_state.json is
+#: digest-covered.
+_RECOVERABLE_LOAD_ERRORS = (OSError, EOFError,
+                            json.JSONDecodeError, _SafetensorError)
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
+                    fallback: Optional[bool] = None,
                     ) -> Tuple[Optional[str], Dict]:
     """Load into the engine, resharding to the engine's current topology
     (the universal-checkpoint property).
@@ -230,22 +568,101 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     ``load_optimizer_states=False`` (reference: ``engine.load_checkpoint``
     kwarg) keeps the engine's fresh optimizer state — required when the
     optimizer config (and hence state structure) changed between save and load.
-    """
-    from ..loss_scaler import LossScaleState
 
+    Every native checkpoint is verified against its manifest before any
+    bytes are deserialized.  ``fallback`` (default: the
+    ``checkpoint.fallback_on_corruption`` config knob) controls what
+    happens when the chosen tag is corrupt: False raises
+    ``CheckpointIntegrityError``; True walks committed tags newest→oldest
+    and loads the newest valid one — one corrupt save must not turn into a
+    permanent crash-loop.
+    """
+    cfg = engine.config.checkpoint
+    if fallback is None:
+        fallback = cfg.fallback_on_corruption
+    requested = tag
+    pointer = None
     if tag is None:
         latest = os.path.join(load_dir, _LATEST)
-        if not os.path.exists(latest):
+        if os.path.exists(latest):
+            pointer = tag = open(latest).read().strip()
+
+    if cfg.engine == "orbax":
+        # orbax owns its own atomicity/integrity story
+        if tag is None:
             logger.warning(f"no {_LATEST} file in {load_dir}")
             return None, {}
-        tag = open(latest).read().strip()
-    ckpt_dir = os.path.join(load_dir, tag)
-    if not os.path.isdir(ckpt_dir):
-        raise FileNotFoundError(f"checkpoint dir not found: {ckpt_dir}")
-
-    if engine.config.checkpoint.engine == "orbax":
+        ckpt_dir = os.path.join(load_dir, tag)
+        if not os.path.isdir(ckpt_dir):
+            raise FileNotFoundError(f"checkpoint dir not found: {ckpt_dir}")
         return _load_orbax(engine, ckpt_dir,
                            load_optimizer_states=load_optimizer_states)
+
+    if requested is not None:
+        # an explicitly requested tag is tried first even under fallback
+        order: List[str] = [requested]
+        if fallback:
+            order += [t for t in checkpoint_candidates(load_dir)
+                      if t not in order]
+    elif fallback:
+        # newest-first over every committed tag — NOT pointer-first: a
+        # commit that landed right before a crash (latest pointer not yet
+        # updated) is newer than the pointer's target and perfectly valid,
+        # so resume from it
+        order = checkpoint_candidates(load_dir)
+        if pointer is not None and pointer not in order:
+            order.append(pointer)
+    else:
+        order = [pointer] if pointer is not None else []
+    if not order:
+        logger.warning(f"no {_LATEST} file in {load_dir}")
+        return None, {}
+
+    failures: List[str] = []
+    for t in order:
+        ckpt_dir = os.path.join(load_dir, t)
+        if not os.path.isdir(ckpt_dir):
+            if not fallback:
+                raise FileNotFoundError(f"checkpoint dir not found: {ckpt_dir}")
+            failures.append(f"{t}: directory missing")
+            continue
+        problems = verify_checkpoint(ckpt_dir,
+                                     check_digests=cfg.integrity != "none")
+        if _is_legacy_only(problems):
+            logger.warning(f"checkpoint {t} predates manifests — loading "
+                           "unverified")
+            problems = []
+        if problems:
+            msg = f"checkpoint {t} failed verification: {problems}"
+            if not fallback:
+                raise CheckpointIntegrityError(msg)
+            logger.error(f"{msg} — falling back to an older checkpoint")
+            failures.append(msg)
+            continue
+        try:
+            result = _load_native(engine, ckpt_dir, load_optimizer_states)
+        except _RECOVERABLE_LOAD_ERRORS as e:
+            # damage the manifest could not see (e.g. a torn write that
+            # landed before the manifest digests were computed from disk)
+            if not fallback:
+                raise
+            logger.error(f"checkpoint {t} failed to load ({e!r}) — "
+                         "falling back to an older checkpoint")
+            failures.append(f"{t}: load failed: {e!r}")
+            continue
+        expected = requested or pointer
+        if expected is not None and t != expected:
+            logger.warning(f"resumed from {t} (newest valid checkpoint) "
+                           f"instead of {expected}")
+        return result
+    raise CheckpointIntegrityError(
+        f"no valid checkpoint under {load_dir} (tried {len(order)} tag(s)): "
+        + "; ".join(failures))
+
+
+def _load_native(engine, ckpt_dir: str, load_optimizer_states: bool
+                 ) -> Tuple[str, Dict]:
+    from ..loss_scaler import LossScaleState
 
     with open(os.path.join(ckpt_dir, "engine_state.json")) as f:
         meta = json.load(f)
@@ -437,8 +854,7 @@ def _save_orbax(engine, save_dir: str, tag: str) -> str:
                        "zero_stage": engine.zero_stage,
                        "world_size": engine.topo.world_size,
                        "framework_version": _version()}, f)
-        with open(os.path.join(save_dir, _LATEST), "w") as f:
-            f.write(tag)
+        _write_latest(save_dir, tag)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

@@ -391,6 +391,13 @@ class CheckpointConfig(DSConfigModel):
     async_save: bool = False
     engine: str = "native"  # native | orbax | fast
     keep_n_latest: Optional[int] = None
+    #: manifest digest algorithm for the atomic-commit protocol
+    #: (runtime/checkpoint/engine.py): none | crc32 | sha256.  "none" still
+    #: writes the manifest (existence+size checks) but skips digests.
+    integrity: str = "sha256"
+    #: on a corrupt/unverifiable checkpoint, walk tags newest→oldest and
+    #: load the newest committed-and-valid one instead of raising
+    fallback_on_corruption: bool = True
 
 
 class GradientCompressionConfig(DSConfigModel):

@@ -1577,11 +1577,13 @@ class TrainingEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
+                        fallback: Optional[bool] = None,
                         ) -> Tuple[Optional[str], Dict]:
         from .checkpoint.engine import load_checkpoint as _load
 
         return _load(self, load_dir, tag=tag,
-                     load_optimizer_states=load_optimizer_states)
+                     load_optimizer_states=load_optimizer_states,
+                     fallback=fallback)
 
     def export_merged_weights(self, save_dir: str, tag: str = "merged") -> str:
         """PEFT serving export: fold LoRA adapters into the base weights and

@@ -44,6 +44,17 @@ then
     exit 2
 fi
 
+# fault-tolerance suite: its imports pull in the durability stack
+# (faults harness, checkpoint commit protocol, elastic agent)
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_fault_tolerance.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_fault_tolerance.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi

@@ -55,7 +55,9 @@ def test_checkpoint_reshard_across_zero_stages(tmp_path, devices):
 
     e3 = _make_engine(stage=3)
     e3.load_checkpoint(str(tmp_path))
-    np.testing.assert_allclose(e3.eval_batch(batch)["loss"], loss, rtol=1e-4)
+    # rtol: the eval runs in bf16 under DIFFERENT shardings (dp=8 vs fsdp=8
+    # reduction orders) — observed drift ~1.6e-4, so 1e-4 was flaky-tight
+    np.testing.assert_allclose(e3.eval_batch(batch)["loss"], loss, rtol=1e-3)
     # params really sharded in the stage-3 engine
     w = e3.state.params["layers"]["mlp"]["w_in"]
     assert not w.sharding.is_fully_replicated

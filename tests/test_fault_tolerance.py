@@ -1,0 +1,420 @@
+"""Crash-safe checkpointing: recovery is PROVEN, not assumed.
+
+Subprocess tests hard-kill (``os._exit`` via the fault harness,
+``utils/faults.py``) a saver at every registered checkpoint-write fault
+site, then assert the two durability invariants from the commit protocol
+(``runtime/checkpoint/engine.py``):
+
+1. the checkpoint directory contains no committed-but-invalid tag —
+   every committed tag passes ``verify_checkpoint``;
+2. ``load_checkpoint(fallback=True)`` restores the newest valid
+   checkpoint (and the elastic agent's relaunch path picks the same tag).
+
+In-process tests cover manifest verification (bit-flip, truncation),
+async-save failure propagation, staging-dir garbage collection, prune
+safety, and the elastic agent's corrupt-tag skip + restart backoff.
+
+The saver here is a structural dummy engine (real ``EngineState``, tiny
+arrays) — the full-engine save/load paths are exercised by
+tests/test_checkpoint.py; these tests are about the durability protocol,
+so they keep the subprocess turnaround at import speed.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_EXIT_CODE = 70        # faults.py default for kind=exit
+_CHILD_SURVIVED = 3    # child's own "armed fault never fired" code
+
+
+def _dummy_engine(step=0, seed=0, **ckpt_kwargs):
+    """Structurally-complete stand-in for a TrainingEngine: everything the
+    checkpoint engine touches, nothing it doesn't.  ``seed`` keys the
+    param values, so a parent process can reconstruct exactly what a
+    killed child had saved."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.config import CheckpointConfig
+    from deepspeed_tpu.runtime.engine import EngineState
+    from deepspeed_tpu.runtime.loss_scaler import LossScaleState
+
+    rng = np.random.default_rng(seed)
+    params = {"w": jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32)),
+              "b": jnp.asarray(rng.normal(size=(32,)).astype(np.float32))}
+    opt = {"mu": jax.tree.map(jnp.zeros_like, params)}
+    state = EngineState(
+        step=jnp.asarray(step, jnp.int32), params=params, opt_state=opt,
+        loss_scale=LossScaleState(scale=jnp.asarray(1.0, jnp.float32),
+                                  good_steps=jnp.asarray(0, jnp.int32),
+                                  hysteresis=jnp.asarray(1, jnp.int32)),
+        rng=jnp.zeros((2,), jnp.uint32),
+        skipped_steps=jnp.asarray(0, jnp.int32))
+    return SimpleNamespace(
+        config=SimpleNamespace(checkpoint=CheckpointConfig(**ckpt_kwargs)),
+        state=state, zero_stage=0, topo=SimpleNamespace(world_size=1),
+        peft_enabled=False, offloaded_optimizer=None, global_steps=step)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    from deepspeed_tpu.utils import faults
+
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _bitflip(path, offset=100):
+    with open(path, "rb+") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+# ---------------------------------------------------------------------------
+# subprocess hard-kill at every fault site → recovery
+# ---------------------------------------------------------------------------
+
+def _child_main(save_dir, mode):
+    """Save step 1 (clean), then step 2 with a fault armed via
+    $DSTPU_FAULTS — the armed site hard-kills this process mid-save."""
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    ck.save_checkpoint(_dummy_engine(step=1, seed=1), save_dir)
+    eng = _dummy_engine(step=2, seed=2)
+    if mode == "fast":
+        eng.config.checkpoint.engine = "fast"
+    ck.save_checkpoint(eng, save_dir)
+    sys.exit(_CHILD_SURVIVED)
+
+
+def _run_killed_child(save_dir, faults_spec, mode="native"):
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": _REPO_ROOT + os.pathsep
+                + env.get("PYTHONPATH", ""),
+                "DSTPU_ACCELERATOR": "cpu", "JAX_PLATFORMS": "cpu",
+                "DSTPU_FAULTS": faults_spec})
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "child",
+         str(save_dir), mode],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == _EXIT_CODE, (
+        f"expected hard-kill rc {_EXIT_CODE}, got {proc.returncode}\n"
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}")
+
+
+def _assert_recovers(save_dir, expected_step):
+    """The two durability invariants, plus exact state equality."""
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    # (1) no committed-but-invalid tag
+    committed = ck.checkpoint_candidates(str(save_dir))
+    assert committed, "hard kill destroyed every checkpoint"
+    for tag in committed:
+        assert ck.verify_checkpoint(os.path.join(str(save_dir), tag)) == [], \
+            f"committed tag {tag} is invalid"
+
+    # (2) fallback load restores the newest valid checkpoint, bit-exact
+    eng = _dummy_engine(step=0, seed=99)
+    ckpt_dir, _ = ck.load_checkpoint(eng, str(save_dir), fallback=True)
+    assert ckpt_dir is not None
+    assert int(eng.state.step) == expected_step
+    saved = _dummy_engine(step=expected_step, seed=expected_step)
+    np.testing.assert_array_equal(np.asarray(eng.state.params["w"]),
+                                  np.asarray(saved.state.params["w"]))
+
+    # the elastic agent's pre-relaunch validation picks the same tag
+    assert ck.find_latest_valid_checkpoint(str(save_dir)) == \
+        f"global_step{expected_step}"
+
+    # the next save garbage-collects any .tmp leftover the kill orphaned
+    ck.save_checkpoint(_dummy_engine(step=3, seed=3), str(save_dir))
+    leftovers = [d for d in os.listdir(save_dir) if d.endswith(".tmp")]
+    assert leftovers == []
+
+
+# each site is hit once per save, so `exit@2` deterministically kills the
+# SECOND save there.  Sites up to ckpt.commit die before global_step2
+# exists → recovery lands on step 1; ckpt.latest dies after the commit
+# rename but before the pointer update → step 2 is committed and valid,
+# and the newest-first walk must find it despite the stale pointer.
+@pytest.mark.parametrize("site,expected_step", [
+    ("ckpt.write.model", 1),
+    ("ckpt.write.optimizer", 1),
+    ("ckpt.write.meta", 1),
+    ("ckpt.write.manifest", 1),
+    ("ckpt.commit", 1),
+    ("ckpt.latest", 2),
+])
+def test_hard_kill_native_save_recovers(tmp_path, site, expected_step):
+    _run_killed_child(tmp_path, f"{site}=exit@2")
+    _assert_recovers(tmp_path, expected_step)
+
+
+@pytest.mark.parametrize("site", ["io.fast.submit", "io.fast.drain"])
+def test_hard_kill_fast_save_recovers(tmp_path, site):
+    # save 1 is native (the fast sites never fire), save 2 goes through
+    # the FastPersist AIO writer and dies at its first submit/drain
+    _run_killed_child(tmp_path, f"{site}=exit", mode="fast")
+    _assert_recovers(tmp_path, expected_step=1)
+
+
+# ---------------------------------------------------------------------------
+# manifest verification
+# ---------------------------------------------------------------------------
+
+def test_verify_detects_bitflip_and_truncation(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    ckpt = ck.save_checkpoint(_dummy_engine(step=1, seed=1), str(tmp_path))
+    assert ck.verify_checkpoint(ckpt) == []
+
+    _bitflip(os.path.join(ckpt, "model.safetensors"))
+    problems = ck.verify_checkpoint(ckpt)
+    assert problems and "digest mismatch" in problems[0]
+
+    with open(os.path.join(ckpt, "optimizer.safetensors"), "rb+") as f:
+        f.truncate(64)
+    problems = ck.verify_checkpoint(ckpt)
+    assert any("size" in p for p in problems)
+
+    os.unlink(os.path.join(ckpt, "engine_state.json"))
+    assert any("missing" in p for p in ck.verify_checkpoint(ckpt))
+
+
+def test_fallback_walks_past_corrupt_latest(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    ck.save_checkpoint(_dummy_engine(step=1, seed=1), str(tmp_path))
+    ckpt2 = ck.save_checkpoint(_dummy_engine(step=2, seed=2), str(tmp_path))
+    _bitflip(os.path.join(ckpt2, "model.safetensors"))
+
+    with pytest.raises(ck.CheckpointIntegrityError):
+        ck.load_checkpoint(_dummy_engine(seed=9), str(tmp_path),
+                           fallback=False)
+
+    eng = _dummy_engine(seed=9)
+    ckpt_dir, _ = ck.load_checkpoint(eng, str(tmp_path), fallback=True)
+    assert ckpt_dir.endswith("global_step1") and int(eng.state.step) == 1
+
+    # every tag corrupt → integrity error, not a silent fresh start
+    _bitflip(os.path.join(str(tmp_path), "global_step1",
+                          "model.safetensors"))
+    with pytest.raises(ck.CheckpointIntegrityError):
+        ck.load_checkpoint(_dummy_engine(seed=9), str(tmp_path),
+                           fallback=True)
+
+
+def test_torn_write_undetected_by_manifest_falls_back_on_load(tmp_path):
+    """A truncation injected BEFORE the manifest digests are computed is
+    invisible to verify (digests are read back from disk) — the fallback
+    walk must catch the parse failure at load time instead."""
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+    from deepspeed_tpu.utils import faults
+
+    ck.save_checkpoint(_dummy_engine(step=1, seed=1), str(tmp_path))
+    faults.configure({"ckpt.truncate.model": "truncate:64"})
+    ckpt2 = ck.save_checkpoint(_dummy_engine(step=2, seed=2), str(tmp_path))
+    faults.reset()
+    assert ck.verify_checkpoint(ckpt2) == []  # manifest matches the torn file
+
+    eng = _dummy_engine(seed=9)
+    ckpt_dir, _ = ck.load_checkpoint(eng, str(tmp_path), fallback=True)
+    assert ckpt_dir.endswith("global_step1") and int(eng.state.step) == 1
+
+
+def test_legacy_checkpoint_without_manifest_still_loads(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    ckpt = ck.save_checkpoint(_dummy_engine(step=1, seed=1), str(tmp_path))
+    os.unlink(os.path.join(ckpt, "manifest.json"))  # pre-manifest layout
+    assert ck.verify_checkpoint(ckpt) == ["missing manifest.json"]
+    assert ck.find_latest_valid_checkpoint(str(tmp_path)) == "global_step1"
+
+    eng = _dummy_engine(seed=9)
+    ckpt_dir, _ = ck.load_checkpoint(eng, str(tmp_path), fallback=True)
+    assert int(eng.state.step) == 1
+
+
+def test_fast_engine_save_is_committed_and_verified(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    eng = _dummy_engine(step=5, seed=5, engine="fast")
+    ckpt = ck.save_checkpoint(eng, str(tmp_path))
+    assert ck.verify_checkpoint(ckpt) == []
+    loaded = _dummy_engine(seed=9)
+    ck.load_checkpoint(loaded, str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(loaded.state.params["w"]),
+                                  np.asarray(eng.state.params["w"]))
+
+
+# ---------------------------------------------------------------------------
+# async-save failure propagation
+# ---------------------------------------------------------------------------
+
+def _drain_async_threads(timeout=15.0):
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    deadline = time.monotonic() + timeout
+    while any(t.is_alive() for t in ck._async_threads):
+        assert time.monotonic() < deadline, "async save thread hung"
+        time.sleep(0.01)
+
+
+def test_async_save_failure_raises_from_wait(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+    from deepspeed_tpu.utils import faults
+
+    faults.configure({"ckpt.write.optimizer": "ioerror:ENOSPC"})
+    ck.save_checkpoint(_dummy_engine(step=1, seed=1, async_save=True),
+                       str(tmp_path))
+    with pytest.raises(IOError, match="injected fault"):
+        ck.wait_for_async_saves()
+    assert ck._async_errors == []  # drained, not sticky
+
+
+def test_async_save_failure_raises_at_next_save(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+    from deepspeed_tpu.utils import faults
+
+    faults.configure({"ckpt.write.model": "ioerror"})
+    ck.save_checkpoint(_dummy_engine(step=1, seed=1, async_save=True),
+                       str(tmp_path))
+    _drain_async_threads()
+    faults.reset()
+    with pytest.raises(IOError, match="injected fault"):
+        ck.save_checkpoint(_dummy_engine(step=2, seed=2), str(tmp_path))
+    ck.wait_for_async_saves()
+
+    # the failed save left only an uncommitted staging dir; the next good
+    # save GC's it and commits normally
+    ck.save_checkpoint(_dummy_engine(step=3, seed=3), str(tmp_path))
+    assert ck.checkpoint_candidates(str(tmp_path)) == ["global_step3"]
+    assert not [d for d in os.listdir(tmp_path) if d.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# GC + prune safety
+# ---------------------------------------------------------------------------
+
+def test_stale_tmp_gc_and_prune_committed_only(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    # orphans from a "crashed" earlier process
+    os.makedirs(tmp_path / "global_step0.tmp")
+    (tmp_path / "global_step0.tmp" / "model.safetensors").write_bytes(b"x")
+    (tmp_path / "latest.tmp").write_text("global_step0")
+
+    for step in range(1, 5):
+        ck.save_checkpoint(
+            _dummy_engine(step=step, seed=step, keep_n_latest=2),
+            str(tmp_path))
+    tags = sorted(d for d in os.listdir(tmp_path)
+                  if d.startswith("global_step") and not d.endswith(".tmp"))
+    assert tags == ["global_step3", "global_step4"]
+    assert not [d for d in os.listdir(tmp_path) if d.endswith(".tmp")]
+    assert (tmp_path / "latest").read_text() == "global_step4"
+
+
+def test_prune_never_deletes_latest_target(tmp_path):
+    """Saves landing out of step order (async completion, manual tags):
+    the latest pointer's target must survive pruning even when it is not
+    the highest step number."""
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    ck.save_checkpoint(_dummy_engine(step=5, seed=5, keep_n_latest=1),
+                       str(tmp_path))
+    ck.save_checkpoint(_dummy_engine(step=4, seed=4, keep_n_latest=1),
+                       str(tmp_path))
+    assert (tmp_path / "latest").read_text() == "global_step4"
+    assert (tmp_path / "global_step4").is_dir()  # latest target kept
+
+    ck._prune_old(str(tmp_path), keep=1)  # direct re-prune: same invariant
+    assert (tmp_path / "global_step4").is_dir()
+
+
+# ---------------------------------------------------------------------------
+# elastic agent: validated auto-resume
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    def __init__(self, rc=0):
+        self._rc = rc
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        pass
+
+    def kill(self):
+        pass
+
+    def wait(self, timeout=None):
+        return self._rc
+
+
+def _capture_agent(tmp_path, captured, **agent_kwargs):
+    from deepspeed_tpu.elasticity.elastic_agent import (AgentConfig,
+                                                        ElasticAgent)
+
+    def launch(member, env):
+        captured.append(env)
+        return _FakeProc(rc=0)
+
+    cfg = AgentConfig(checkpoint_dir=str(tmp_path), poll_interval_s=0.01,
+                      **agent_kwargs)
+    return ElasticAgent(["true"], members_fn=lambda: ["hostA"],
+                        agent_config=cfg, launch_fn=launch)
+
+
+def test_elastic_agent_resumes_from_newest_valid_tag(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    ck.save_checkpoint(_dummy_engine(step=1, seed=1), str(tmp_path))
+    ckpt2 = ck.save_checkpoint(_dummy_engine(step=2, seed=2), str(tmp_path))
+    _bitflip(os.path.join(ckpt2, "model.safetensors"))
+
+    captured = []
+    agent = _capture_agent(tmp_path, captured)
+    assert agent.run() == 0  # fake workers exit clean
+    assert captured[0]["DSTPU_RESUME_TAG"] == "global_step1"
+
+
+def test_elastic_agent_backoff_when_no_valid_checkpoint(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import engine as ck
+
+    ckpt = ck.save_checkpoint(_dummy_engine(step=1, seed=1), str(tmp_path))
+    _bitflip(os.path.join(ckpt, "model.safetensors"))
+
+    captured = []
+    agent = _capture_agent(tmp_path, captured, restart_backoff_s=0.2,
+                           restart_backoff_max_s=0.2)
+    agent.restart_count = 1  # a relaunch, not the initial start
+    t0 = time.monotonic()
+    agent._start_group(["hostA"])
+    assert time.monotonic() - t0 >= 0.15  # backoff applied
+    assert "DSTPU_RESUME_TAG" not in captured[0]  # nothing valid to pin
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "child":
+        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _child_main(sys.argv[2], sys.argv[3])
+    else:
+        sys.exit(pytest.main([__file__, "-v"]))

@@ -23,6 +23,14 @@ BASE = {
 }
 
 
+# Tests that assert host-space placement need a backend with a pinned_host
+# memory space; this JAX CPU build has none (the engine warns and falls back
+# to device memory), so they only run where the capability exists (TPU/GPU).
+_needs_pinned_host = pytest.mark.skipif(
+    not param_offload.host_memory_available(),
+    reason="backend exposes no pinned_host memory space")
+
+
 def _cfg(**zero):
     cfg = dict(BASE)
     # tiny fixture leaves sit under the default persistence threshold (1e5
@@ -55,6 +63,7 @@ def test_offload_mask_selects_scanned_stack():
     assert all(jax.tree.leaves(mask_t["layers"]["attn"]))
 
 
+@_needs_pinned_host
 def test_param_offload_params_live_in_host_memory():
     spec = tiny_lm_spec(param_dtype="float32")
     engine, *_ = deepspeed_tpu.initialize(
@@ -98,6 +107,7 @@ def test_param_offload_loss_decreases():
     assert last < first
 
 
+@_needs_pinned_host
 def test_param_offload_grad_step_consumes_host_params():
     """The grad step runs directly on host-space params (no eager gather of
     the stack to device first) and produces finite grads.  (Grad writeback to
@@ -117,6 +127,7 @@ def test_param_offload_grad_step_consumes_host_params():
     assert all(bool(jnp.all(jnp.isfinite(g))) for g in jax.tree.leaves(grads))
 
 
+@_needs_pinned_host
 def test_param_offload_device_budget():
     """Device working set is O(layer), not O(model): the compiled grad step's
     device-memory footprint must stay well below the full param+grad bytes.
@@ -178,6 +189,7 @@ def test_nvme_param_tier_pages_master(tmp_path, stage):
         jax.device_get(ref.state.params), p)
 
 
+@_needs_pinned_host
 def test_zero_infinity_example_config_dryruns():
     """The shipped examples/llama3_70b_zero_infinity.json drives the full
     ZeRO-3 × param-offload × NVMe path (model scaled down for CI)."""
