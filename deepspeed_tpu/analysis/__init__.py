@@ -1,0 +1,89 @@
+"""deepspeed_tpu.analysis — static analysis of compiled (optimized) HLO.
+
+The repo's perf discipline is "measure the compiled program, not the
+source": every claim about collectives, donation, or host traffic is
+audited from ``lowered.compile().as_text()``.  Before this subsystem that
+audit lived in five independent ad-hoc regex greps with five independent
+parsing bugs.  This package is the single implementation:
+
+* :mod:`~deepspeed_tpu.analysis.ir` — a light parsed IR over HLO text
+  (instructions, shapes/dtypes incl. fp8, computations incl. while
+  bodies, input-output aliasing, buffer donors);
+* :mod:`~deepspeed_tpu.analysis.passes` — the pass framework and the
+  initial suite: collective census + bytes (async start/done pairing,
+  channel-id dedup, loop-body membership), donation/aliasing audit,
+  host-sync detector, dtype-promotion lint, replicated-large-tensor
+  detector;
+* :mod:`~deepspeed_tpu.analysis.budgets` — declarative per-program
+  ceilings (``budgets.toml``) and the checker the CI gate runs;
+* :mod:`~deepspeed_tpu.analysis.programs` — the flagship-program
+  registry (train_step@zero{0..3}, train_step@lora, decode_step@v2,
+  onebit_step) compiled over virtual meshes;
+* ``python -m deepspeed_tpu.analysis`` — compiles the flagship programs
+  and emits a JSON report + pass/fail against the budgets.
+
+Reference for the role: ``deepspeed/compile/`` (compile-time graph
+passes) and the flops profiler — here the compiler already did the
+scheduling, so the subsystem's job is to *audit* what it emitted and
+regression-gate it (tests/test_analysis_gate.py).
+"""
+
+from .ir import (
+    DTYPE_BITS,
+    HloComputation,
+    HloInstruction,
+    HloModule,
+    InputOutputAlias,
+    Shape,
+    UnknownDtypeError,
+    dtype_nbytes,
+    parse_hlo,
+)
+from .passes import (
+    AnalysisContext,
+    AnalysisPass,
+    CollectiveCensusPass,
+    DonationAuditPass,
+    DtypePromotionPass,
+    HostSyncPass,
+    ReplicatedTensorPass,
+    analyze,
+    collective_bytes,
+    collective_census,
+    default_passes,
+)
+from .budgets import (
+    BudgetError,
+    BudgetViolation,
+    check_budgets,
+    default_budgets_path,
+    load_budgets,
+)
+
+__all__ = [
+    "DTYPE_BITS",
+    "HloComputation",
+    "HloInstruction",
+    "HloModule",
+    "InputOutputAlias",
+    "Shape",
+    "UnknownDtypeError",
+    "dtype_nbytes",
+    "parse_hlo",
+    "AnalysisContext",
+    "AnalysisPass",
+    "CollectiveCensusPass",
+    "DonationAuditPass",
+    "DtypePromotionPass",
+    "HostSyncPass",
+    "ReplicatedTensorPass",
+    "analyze",
+    "collective_bytes",
+    "collective_census",
+    "default_passes",
+    "BudgetError",
+    "BudgetViolation",
+    "check_budgets",
+    "default_budgets_path",
+    "load_budgets",
+]

@@ -1,0 +1,116 @@
+"""``python -m deepspeed_tpu.analysis`` — compile the flagship programs on
+a virtual mesh, run the pass suite, and check the budgets.
+
+Prints one JSON report; exit status 1 if any budget is violated.  This is
+the same check ``tests/test_analysis_gate.py`` runs in tier-1 — the CLI
+exists so a perf PR can run it directly (and ``--json`` the report into
+its evidence) without going through pytest.
+
+    python -m deepspeed_tpu.analysis                       # all budgeted programs
+    python -m deepspeed_tpu.analysis --programs train_step@zero1,decode_step@v2
+    python -m deepspeed_tpu.analysis --json /tmp/report.json --quiet
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict
+
+
+def _parse_args(argv) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(prog="python -m deepspeed_tpu.analysis",
+                                 description=__doc__)
+    ap.add_argument("--programs", default=None,
+                    help="comma-separated program names (default: every "
+                         "program named in the budget file)")
+    ap.add_argument("--budgets", default=None,
+                    help="path to budgets.toml (default: the one shipped "
+                         "in deepspeed_tpu/analysis/)")
+    ap.add_argument("--devices", type=int,
+                    default=int(os.environ.get("DSTPU_EVIDENCE_DEVICES",
+                                               "8")),
+                    help="virtual mesh size (default 8)")
+    ap.add_argument("--json", dest="json_path", default=None,
+                    help="also write the report to this path")
+    ap.add_argument("--no-budget-check", action="store_true",
+                    help="report only; do not fail on violations")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the JSON dump on stdout (violations "
+                         "still print to stderr)")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+
+    # virtual mesh before the XLA client exists (same dance as
+    # profiling/compile_evidence.py and tests/conftest.py)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+    os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from .budgets import check_budgets, default_budgets_path, load_budgets
+    from .passes import analyze
+    from .programs import available_programs, build_program
+
+    budgets_path = args.budgets or default_budgets_path()
+    budgets = load_budgets(budgets_path)
+    if args.programs:
+        names = [n.strip() for n in args.programs.split(",") if n.strip()]
+    else:
+        names = [n for n in budgets if n in set(available_programs())]
+
+    report: Dict[str, Any] = {
+        "kind": "hlo_analysis",
+        "budgets": budgets_path,
+        "n_devices": args.devices,
+        "programs": {},
+        "violations": [],
+    }
+    for name in names:
+        try:
+            artifact = build_program(name)
+        except Exception as e:  # noqa: BLE001 — a program that no longer
+            # compiles must fail the gate with its name attached
+            report["programs"][name] = {
+                "error": f"{type(e).__name__}: {e}"}
+            report["violations"].append(
+                {"program": name, "check": "compile", "limit": "compiles",
+                 "actual": f"{type(e).__name__}: {e}"})
+            continue
+        prog_report = analyze(artifact.hlo_text, artifact.ctx)
+        budget = budgets.get(name)
+        if budget is not None:
+            violations = check_budgets(prog_report, budget, name)
+            prog_report["violations"] = [v.to_dict() for v in violations]
+            report["violations"].extend(prog_report["violations"])
+        report["programs"][name] = prog_report
+
+    report["ok"] = not report["violations"]
+    text = json.dumps(report, indent=2, sort_keys=True)
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            f.write(text + "\n")
+    if not args.quiet:
+        print(text)
+    for v in report["violations"]:
+        print(f"BUDGET VIOLATION [{v['program']}] {v['check']}: "
+              f"actual {v['actual']} vs budget {v['limit']}",
+              file=sys.stderr)
+    if report["violations"] and not args.no_budget_check:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,203 @@
+"""Declarative per-program analysis budgets.
+
+``budgets.toml`` gives every flagship program a table of ceilings the
+compiled HLO must stay under.  The format is deliberately flat and
+auditable — raising a budget is a reviewed diff, not a code change::
+
+    [programs."train_step@zero1"]
+    compute_dtype = "bf16"          # anchors the dtype-promotion lint
+    max_host_syncs = 0              # no host round-trips in the hot step
+    min_io_aliases = 1              # donation must materialize as aliases
+    max_donor_unaliased_bytes = 0   # every donated byte must be reused
+    max_replicated_large_params = 0
+    max_collective_bytes = 12000000
+
+    [programs."train_step@zero1".max_collectives]
+    "all-reduce" = 4                # per-op instruction ceilings
+    "all-gather" = 2
+    total = 8
+
+Unknown keys are a hard error (a typo'd budget that never fires is worse
+than no budget).  Checks whose pass reported ``skipped``/``error`` fail
+loudly rather than vacuously passing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "BudgetError",
+    "BudgetViolation",
+    "check_budgets",
+    "default_budgets_path",
+    "load_budgets",
+]
+
+
+class BudgetError(ValueError):
+    """Malformed budget file (unknown key, bad type, missing table)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BudgetViolation:
+    program: str
+    check: str
+    limit: Any
+    actual: Any
+
+    def __str__(self) -> str:
+        return (f"[{self.program}] {self.check}: actual {self.actual} "
+                f"violates budget {self.limit}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+_PROGRAM_KEYS = {
+    # context anchors (consumed by programs/CLI, not checks)
+    "compute_dtype", "mesh_devices", "description",
+    # collectives
+    "max_collectives", "max_collective_total", "max_collective_bytes",
+    "max_collectives_in_loops",
+    # host sync
+    "max_host_syncs",
+    # donation
+    "min_io_aliases", "max_donor_unaliased_bytes",
+    "max_large_unaliased_bytes", "min_alias_fraction",
+    # replication
+    "max_replicated_large_params", "max_replicated_param_bytes",
+    # dtype promotion
+    "max_f32_upcast_converts", "max_f32_dots",
+}
+
+
+def default_budgets_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "budgets.toml")
+
+
+def load_budgets(path: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
+    """Load and validate the budget file; returns {program: budget table}."""
+    import tomli
+
+    path = path or default_budgets_path()
+    with open(path, "rb") as f:
+        data = tomli.load(f)
+    programs = data.get("programs")
+    if not isinstance(programs, dict) or not programs:
+        raise BudgetError(f"{path}: missing [programs.\"<name>\"] tables")
+    for name, table in programs.items():
+        if not isinstance(table, dict):
+            raise BudgetError(f"{path}: programs.{name} is not a table")
+        unknown = set(table) - _PROGRAM_KEYS
+        if unknown:
+            raise BudgetError(
+                f"{path}: unknown budget key(s) {sorted(unknown)} for "
+                f"program {name!r}; known keys: {sorted(_PROGRAM_KEYS)}")
+        mc = table.get("max_collectives", {})
+        if not isinstance(mc, dict):
+            raise BudgetError(
+                f"{path}: programs.{name}.max_collectives must be a table "
+                f"of per-op ceilings")
+    return programs
+
+
+def _require(report: Dict[str, Any], pass_name: str, program: str) -> Dict:
+    p = report.get("passes", {}).get(pass_name)
+    if p is None or "error" in p or "skipped" in p:
+        raise BudgetError(
+            f"budget for {program!r} needs pass {pass_name!r} but the "
+            f"report has {p!r} — a budget must never pass vacuously")
+    return p
+
+
+def check_budgets(report: Dict[str, Any],
+                  budget: Dict[str, Any],
+                  program: str) -> List[BudgetViolation]:
+    """Compare one program's analysis report against its budget table."""
+    v: List[BudgetViolation] = []
+
+    def _ceiling(check: str, actual, limit) -> None:
+        if actual > limit:
+            v.append(BudgetViolation(program, check, limit, actual))
+
+    def _floor(check: str, actual, limit) -> None:
+        if actual < limit:
+            v.append(BudgetViolation(program, check, limit, actual))
+
+    mc = budget.get("max_collectives")
+    needs_coll = (mc or "max_collective_total" in budget
+                  or "max_collective_bytes" in budget
+                  or "max_collectives_in_loops" in budget)
+    if needs_coll:
+        coll = _require(report, "collectives", program)
+        for op, limit in (mc or {}).items():
+            if op == "total":
+                _ceiling("collectives.total", coll["total"], limit)
+            else:
+                _ceiling(f"collectives.{op}",
+                         coll["collectives"].get(op, 0), limit)
+        if "max_collective_total" in budget:
+            _ceiling("collectives.total", coll["total"],
+                     budget["max_collective_total"])
+        if "max_collective_bytes" in budget:
+            _ceiling("collectives.total_bytes", coll["total_bytes"],
+                     budget["max_collective_bytes"])
+        if "max_collectives_in_loops" in budget:
+            _ceiling("collectives.in_loop_body",
+                     sum(coll["in_loop_body"].values()),
+                     budget["max_collectives_in_loops"])
+
+    if "max_host_syncs" in budget:
+        hs = _require(report, "host_sync", program)
+        _ceiling("host_sync.count", hs["count"], budget["max_host_syncs"])
+
+    donation_keys = ("min_io_aliases", "max_donor_unaliased_bytes",
+                     "max_large_unaliased_bytes", "min_alias_fraction")
+    if any(k in budget for k in donation_keys):
+        d = _require(report, "donation", program)
+        if "min_io_aliases" in budget:
+            _floor("donation.n_aliases", d["n_aliases"],
+                   budget["min_io_aliases"])
+        if "max_donor_unaliased_bytes" in budget:
+            _ceiling("donation.donor_unaliased_bytes",
+                     d["donor_unaliased_bytes"],
+                     budget["max_donor_unaliased_bytes"])
+        if "max_large_unaliased_bytes" in budget:
+            _ceiling("donation.large_unaliased_bytes",
+                     d["large_unaliased_bytes"],
+                     budget["max_large_unaliased_bytes"])
+        if "min_alias_fraction" in budget:
+            frac = d.get("alias_fraction")
+            if frac is None:
+                raise BudgetError(
+                    f"budget for {program!r} sets min_alias_fraction but "
+                    f"the program declared no donated_intent_bytes")
+            _floor("donation.alias_fraction", frac,
+                   budget["min_alias_fraction"])
+
+    if "max_replicated_large_params" in budget or \
+            "max_replicated_param_bytes" in budget:
+        r = _require(report, "replication", program)
+        if "max_replicated_large_params" in budget:
+            _ceiling("replication.n_replicated_params",
+                     r["n_replicated_params"],
+                     budget["max_replicated_large_params"])
+        if "max_replicated_param_bytes" in budget:
+            _ceiling("replication.replicated_param_bytes",
+                     r["replicated_param_bytes"],
+                     budget["max_replicated_param_bytes"])
+
+    if "max_f32_upcast_converts" in budget or "max_f32_dots" in budget:
+        dp = _require(report, "dtype_promotion", program)
+        if "max_f32_upcast_converts" in budget:
+            _ceiling("dtype_promotion.f32_upcast_converts",
+                     dp["f32_upcast_converts"],
+                     budget["max_f32_upcast_converts"])
+        if "max_f32_dots" in budget:
+            _ceiling("dtype_promotion.f32_dots", dp["f32_dots"],
+                     budget["max_f32_dots"])
+
+    return v

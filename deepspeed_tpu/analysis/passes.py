@@ -1,0 +1,390 @@
+"""Analysis passes over the parsed HLO IR.
+
+Each pass is a pure function of ``(HloModule, AnalysisContext)`` returning
+a JSON-able dict of metrics; it never judges.  Judgement lives in
+:mod:`~deepspeed_tpu.analysis.budgets`, where ``budgets.toml`` declares
+per-program ceilings and the CI gate compares.
+
+The context carries what the HLO alone cannot know: the compute dtype the
+program was *supposed* to run in, how many devices the mesh has (a
+replicated tensor is only waste when there is more than one), and the
+byte volume the caller *intended* to donate (so the donation audit can
+report a fraction, not just a count).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from .ir import DTYPE_BITS, HloInstruction, HloModule, parse_hlo
+
+__all__ = [
+    "AnalysisContext",
+    "AnalysisPass",
+    "CollectiveCensusPass",
+    "DonationAuditPass",
+    "DtypePromotionPass",
+    "HostSyncPass",
+    "ReplicatedTensorPass",
+    "analyze",
+    "collective_bytes",
+    "collective_census",
+    "default_passes",
+]
+
+_MiB = 1 << 20
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    """Program-level facts the passes need beyond the HLO text."""
+
+    program: str = ""
+    compute_dtype: Optional[str] = None  # e.g. "bf16" — dtype lint anchor
+    mesh_devices: int = 1
+    donated_intent_bytes: Optional[int] = None  # bytes of donate_argnums args
+    large_param_threshold: int = _MiB  # donation/replication "large" cutoff
+    min_promotion_elements: int = 1024  # dtype lint ignores scalar glue
+    memory_stats: Optional[Dict[str, int]] = None  # from memory_analysis()
+
+
+class AnalysisPass:
+    name: str = "base"
+
+    def run(self, module: HloModule, ctx: AnalysisContext) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# collective census + bytes
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_RE = re.compile(
+    r"^(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all|"
+    r"collective-broadcast|ragged-all-to-all)(-start|-done)?$")
+
+
+class CollectiveCensusPass(AnalysisPass):
+    """Counts + result-shape bytes of every collective, with:
+
+    * async pairing — a ``*-start``/``*-done`` pair counts ONCE: the start
+      carries the count (and the async tally), the done carries the bytes
+      (the done's result IS the collective's result; the start's is a
+      backend tuple of aliases and context tokens);
+    * channel-id dedup — partitioned modules can print the same logical
+      collective under several instructions sharing ``channel_id``; each
+      (op, channel) counts once;
+    * loop membership — a collective inside a ``while`` body (even via a
+      fusion the body calls) is counted once *statically* and reported
+      under ``in_loop_body``, since its dynamic count is trip-dependent.
+    """
+
+    name = "collectives"
+
+    def run(self, module: HloModule, ctx: AnalysisContext) -> Dict[str, Any]:
+        counts: Dict[str, int] = collections.Counter()
+        async_started: Dict[str, int] = collections.Counter()
+        in_loop: Dict[str, int] = collections.Counter()
+        nbytes: Dict[str, int] = collections.Counter()
+        loops = module.loop_computations()
+        seen_channels = set()
+        for comp, inst in module.instructions():
+            m = _COLLECTIVE_RE.match(inst.opcode)
+            if m is None:
+                continue
+            base, suffix = m.group(1), m.group(2)
+            if suffix != "-done":
+                chan = inst.channel_id
+                if chan is not None:
+                    if (base, chan) in seen_channels:
+                        continue
+                    seen_channels.add((base, chan))
+                counts[base] += 1
+                if suffix == "-start":
+                    async_started[base] += 1
+                if comp.name in loops:
+                    in_loop[base] += 1
+            if suffix != "-start":
+                # sync op or async done: result-shape bytes
+                nbytes[base] += inst.shape.nbytes
+        return {
+            "collectives": dict(counts),
+            "async_started": dict(async_started),
+            "in_loop_body": dict(in_loop),
+            "bytes": dict(nbytes),
+            "total": int(sum(counts.values())),
+            "total_async": int(sum(async_started.values())),
+            "total_bytes": int(sum(nbytes.values())),
+        }
+
+
+# ---------------------------------------------------------------------------
+# donation / aliasing audit
+# ---------------------------------------------------------------------------
+
+
+class DonationAuditPass(AnalysisPass):
+    """Did every donation intent become a real input-output alias?
+
+    ``donate_argnums`` is a *request*; XLA materializes it either as an
+    ``input_output_alias`` entry (buffer reused — the win) or leaves it as
+    a ``buffer_donor`` (donated but NOT aliased to any output — the buffer
+    dies without being reused, so the program still double-buffers).  Any
+    large entry parameter in neither set is an undonated candidate:
+    live-in memory the caller could reclaim.
+    """
+
+    name = "donation"
+
+    def run(self, module: HloModule, ctx: AnalysisContext) -> Dict[str, Any]:
+        entry = module.entry
+        if entry is None:
+            return {"error": "no entry computation"}
+        params = entry.parameters()
+
+        def _pbytes(num: int, index) -> int:
+            inst = params.get(num)
+            if inst is None:
+                return 0
+            try:
+                return inst.shape.index(tuple(index)).nbytes
+            except (IndexError, TypeError):
+                return inst.shape.nbytes
+
+        aliased = module.aliased_params()
+        aliased_bytes = sum(_pbytes(n, i) for (n, i) in aliased)
+        donor_bytes = sum(_pbytes(n, i) for (n, i) in module.buffer_donors)
+        covered = {n for (n, _) in aliased} | \
+                  {n for (n, _) in module.buffer_donors}
+        large_unaliased = []
+        for num, inst in sorted(params.items()):
+            if num in covered:
+                continue
+            b = inst.shape.nbytes
+            if b >= ctx.large_param_threshold:
+                large_unaliased.append({
+                    "param": num, "name": inst.name, "bytes": int(b),
+                    "sharding": inst.sharding})
+        out: Dict[str, Any] = {
+            "n_aliases": len(module.input_output_aliases),
+            "aliased_bytes": int(aliased_bytes),
+            "n_donor_unaliased": len(module.buffer_donors),
+            "donor_unaliased_bytes": int(donor_bytes),
+            "n_large_unaliased": len(large_unaliased),
+            "large_unaliased_bytes": int(sum(p["bytes"]
+                                             for p in large_unaliased)),
+            "large_unaliased": large_unaliased[:16],
+        }
+        if ctx.donated_intent_bytes:
+            out["donated_intent_bytes"] = int(ctx.donated_intent_bytes)
+            out["alias_fraction"] = round(
+                aliased_bytes / ctx.donated_intent_bytes, 4)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# host-sync / transfer detector
+# ---------------------------------------------------------------------------
+
+_HOST_CALLBACK_MARKERS = ("callback", "host", "py_func", "debug_print",
+                          "tpu_outfeed")
+
+
+class HostSyncPass(AnalysisPass):
+    """Host round-trips inside a jitted hot path: infeed/outfeed, host
+    sends/recvs, host-memory-space copies (layout ``S(5)``), and
+    custom-calls into Python/host callbacks (``jax.debug.print``,
+    ``io_callback`` and friends).  Any of these serializes the device
+    stream against the host — zero is the only acceptable budget for a
+    steady-state train/decode step."""
+
+    name = "host_sync"
+
+    def run(self, module: HloModule, ctx: AnalysisContext) -> Dict[str, Any]:
+        loops = module.loop_computations()
+        by_kind: Dict[str, int] = collections.Counter()
+        examples: List[str] = []
+        n_in_loop = 0
+
+        def _hit(kind: str, comp_name: str, inst: HloInstruction) -> None:
+            nonlocal n_in_loop
+            by_kind[kind] += 1
+            if comp_name in loops:
+                n_in_loop += 1
+            if len(examples) < 16:
+                examples.append(f"{kind}:{inst.name}")
+
+        for comp, inst in module.instructions():
+            op = inst.opcode
+            if op in ("infeed", "outfeed"):
+                _hit(op, comp.name, inst)
+            elif op in ("send", "recv", "send-done", "recv-done"):
+                if op.endswith("-done"):
+                    continue  # its start was already counted
+                if "is_host_transfer=true" in inst.attrs:
+                    _hit("host_" + op, comp.name, inst)
+            elif op in ("copy-start", "copy"):
+                # host memory space shows up as S(5) in the result layout
+                if any("S(5)" in leaf.layout for leaf in inst.shape.leaves()):
+                    _hit("host_copy", comp.name, inst)
+            elif op == "custom-call":
+                target = (inst.custom_call_target or "").lower()
+                if any(mark in target for mark in _HOST_CALLBACK_MARKERS):
+                    _hit(f"callback:{inst.custom_call_target}", comp.name,
+                         inst)
+        return {
+            "count": int(sum(by_kind.values())),
+            "in_loop_body": n_in_loop,
+            "by_kind": dict(by_kind),
+            "examples": examples,
+        }
+
+
+# ---------------------------------------------------------------------------
+# dtype-promotion lint
+# ---------------------------------------------------------------------------
+
+
+class DtypePromotionPass(AnalysisPass):
+    """Unexpected f32 upcasts in a reduced-precision program.
+
+    Two smells, given ``ctx.compute_dtype`` (e.g. ``bf16`` or an fp8
+    type): large ``convert``s from the compute dtype to f32, and dots /
+    convolutions computing entirely in f32 operands (a bf16×bf16→f32 dot
+    is *fine* — that is mixed-precision accumulation; f32×f32 operands
+    mean the whole contraction was promoted).  Scalar glue is ignored via
+    ``min_promotion_elements``.  Counts, not verdicts: XLA:CPU legitimately
+    promotes bf16 compute wholesale, so the budget ceiling encodes what
+    the current schedule does and catches *new* promotions.
+    """
+
+    name = "dtype_promotion"
+
+    def run(self, module: HloModule, ctx: AnalysisContext) -> Dict[str, Any]:
+        if ctx.compute_dtype is None:
+            return {"skipped": "no compute_dtype in context"}
+        src = ctx.compute_dtype
+        min_elems = ctx.min_promotion_elements
+        upcast_converts = 0
+        upcast_bytes = 0
+        f32_dots = 0
+        examples: List[str] = []
+        for _, inst in module.instructions():
+            if inst.shape.is_tuple:
+                continue
+            if inst.shape.num_elements < min_elems:
+                continue
+            if (inst.opcode == "convert" and inst.shape.dtype == "f32"
+                    and src in inst.operand_dtypes()):
+                upcast_converts += 1
+                upcast_bytes += inst.shape.nbytes
+                if len(examples) < 8:
+                    examples.append(f"convert:{inst.name}")
+            elif inst.opcode in ("dot", "convolution"):
+                odts = set(inst.operand_dtypes())
+                if inst.shape.dtype == "f32" and odts == {"f32"}:
+                    f32_dots += 1
+                    if len(examples) < 8:
+                        examples.append(f"{inst.opcode}:{inst.name}")
+        return {
+            "compute_dtype": src,
+            "f32_upcast_converts": upcast_converts,
+            "f32_upcast_bytes": int(upcast_bytes),
+            "f32_dots": f32_dots,
+            "examples": examples,
+        }
+
+
+# ---------------------------------------------------------------------------
+# replicated-large-tensor detector
+# ---------------------------------------------------------------------------
+
+
+class ReplicatedTensorPass(AnalysisPass):
+    """Large tensors materialized identically on every device of a >1-chip
+    mesh: entry parameters whose GSPMD sharding is ``{replicated}`` and
+    large constants (always replicated by construction).  Each one costs
+    ``bytes × (devices-1)`` of wasted HBM; ZeRO-3 exists so params do NOT
+    look like this."""
+
+    name = "replication"
+
+    def run(self, module: HloModule, ctx: AnalysisContext) -> Dict[str, Any]:
+        if ctx.mesh_devices <= 1:
+            return {"skipped": "single-device program"}
+        entry = module.entry
+        if entry is None:
+            return {"error": "no entry computation"}
+        threshold = ctx.large_param_threshold
+        replicated = []
+        for num, inst in sorted(entry.parameters().items()):
+            sh = inst.sharding or ""
+            if "replicated" not in sh or "devices=" in sh:
+                continue  # sharded, partially replicated, or unannotated
+            b = inst.shape.nbytes
+            if b >= threshold:
+                replicated.append({"param": num, "name": inst.name,
+                                   "bytes": int(b)})
+        n_large_consts = 0
+        const_bytes = 0
+        for _, inst in module.instructions():
+            if inst.opcode in ("constant", "iota") and \
+                    not inst.shape.is_tuple and inst.shape.nbytes >= threshold:
+                n_large_consts += 1
+                const_bytes += inst.shape.nbytes
+        return {
+            "n_replicated_params": len(replicated),
+            "replicated_param_bytes": int(sum(p["bytes"]
+                                              for p in replicated)),
+            "replicated_params": replicated[:16],
+            "n_large_constants": n_large_consts,
+            "large_constant_bytes": int(const_bytes),
+        }
+
+
+# ---------------------------------------------------------------------------
+# driver + compat conveniences
+# ---------------------------------------------------------------------------
+
+
+def default_passes() -> List[AnalysisPass]:
+    return [CollectiveCensusPass(), DonationAuditPass(), HostSyncPass(),
+            DtypePromotionPass(), ReplicatedTensorPass()]
+
+
+def analyze(hlo: Union[str, HloModule],
+            ctx: Optional[AnalysisContext] = None,
+            passes: Optional[Sequence[AnalysisPass]] = None) -> Dict[str, Any]:
+    """Run the pass suite over HLO text (or a pre-parsed module); returns
+    ``{"module": ..., "passes": {pass_name: metrics}}``."""
+    module = parse_hlo(hlo) if isinstance(hlo, str) else hlo
+    ctx = ctx or AnalysisContext()
+    out: Dict[str, Any] = {
+        "module": module.name,
+        "program": ctx.program,
+        "passes": {},
+    }
+    if ctx.memory_stats:
+        out["memory"] = dict(ctx.memory_stats)
+    for p in (passes if passes is not None else default_passes()):
+        out["passes"][p.name] = p.run(module, ctx)
+    return out
+
+
+def collective_census(hlo: Union[str, HloModule]) -> Dict[str, Any]:
+    """Census of collective ops — the analyzer-backed successor of
+    ``compile_evidence.hlo_collective_census`` (same keys, plus bytes and
+    loop membership)."""
+    module = parse_hlo(hlo) if isinstance(hlo, str) else hlo
+    return CollectiveCensusPass().run(module, AnalysisContext())
+
+
+def collective_bytes(hlo: Union[str, HloModule]) -> Dict[str, int]:
+    """Result-shape bytes per collective op (async pairs counted once, at
+    the ``*-done``) — successor of ``compile_evidence.hlo_collective_bytes``
+    with exact fp8/int4 accounting and an explicit error on unknown
+    dtypes."""
+    return collective_census(hlo)["bytes"]

@@ -26,40 +26,23 @@ and this report is how we audit it.
 
 from __future__ import annotations
 
-import collections
 import json
 import re
 from typing import Any, Dict
-
-_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
-                "collective-permute", "all-to-all")
 
 
 def hlo_collective_census(hlo_text: str) -> Dict[str, Any]:
     """Count collective ops in HLO text.  Async pairs (``*-start``/``*-done``)
     count ONCE (by their start) — both into the per-op census and into the
-    separate async tally, since an async collective is still a collective."""
-    counts: Dict[str, int] = collections.Counter()
-    async_pairs: Dict[str, int] = collections.Counter()
-    for line in hlo_text.splitlines():
-        line = line.strip()
-        for coll in _COLLECTIVES:
-            if re.search(rf"\b{coll}(\.\d+)?\(", line):  # sync form
-                counts[coll] += 1
-            if re.search(rf"\b{coll}-start(\.\d+)?\(", line):  # async form
-                counts[coll] += 1
-                async_pairs[coll] += 1
-    return {"collectives": dict(counts), "async_started": dict(async_pairs),
-            "total": int(sum(counts.values())),
-            "total_async": int(sum(async_pairs.values()))}
+    separate async tally, since an async collective is still a collective.
 
+    Compat shim over :func:`deepspeed_tpu.analysis.collective_census` —
+    the analyzer parses real instructions (no attribute/metadata false
+    positives, channel-id dedup, loop-body membership) instead of the
+    per-line regexes that used to live here."""
+    from ..analysis import collective_census
 
-_DTYPE_BYTES = {
-    "f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4, "u32": 4,
-    "bf16": 2, "f16": 2, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
-}
-
-_SHAPE_RE = re.compile(r"\b([a-z]+\d*(?:e\d+m\d+)?)\[([0-9,]*)\]")
+    return collective_census(hlo_text)
 
 
 def hlo_collective_bytes(hlo_text: str) -> Dict[str, int]:
@@ -70,32 +53,14 @@ def hlo_collective_bytes(hlo_text: str) -> Dict[str, int]:
     instruction: the done's result IS the collective's result, whereas the
     ``*-start`` result is a backend-specific tuple of operand aliases,
     results, and scalar context tokens whose layout a split-in-half
-    heuristic miscounts."""
-    out: Dict[str, int] = collections.Counter()
-    for line in hlo_text.splitlines():
-        line = line.strip()
-        for coll in _COLLECTIVES:
-            # result shapes sit between '=' and the op call; the instruction
-            # NAME left of '=' usually contains the op name too, so anchor
-            # the search after '='
-            m = re.search(rf"=\s*(.*?)\b{coll}(-start|-done)?(?:\.\d+)?\(",
-                          line)
-            if m is None or m.group(2) == "-start":
-                continue
-            shapes = _SHAPE_RE.findall(m.group(1))
-            nbytes = 0
-            for dt, dims in shapes:
-                size = _DTYPE_BYTES.get(dt)
-                if size is None:
-                    continue
-                n = 1
-                for d in dims.split(","):
-                    if d:
-                        n *= int(d)
-                nbytes += n * size
-            out[coll] += nbytes
-            break
-    return dict(out)
+    heuristic miscounts.
+
+    Compat shim over :func:`deepspeed_tpu.analysis.collective_bytes`,
+    which also fixes the fp8/int4 dtype widths this module's old table
+    silently dropped (``UnknownDtypeError`` instead of a silent skip)."""
+    from ..analysis import collective_bytes
+
+    return collective_bytes(hlo_text)
 
 
 def multichip_step_evidence(n_devices: int = 8) -> Dict[str, Any]:

@@ -1160,7 +1160,9 @@ class TrainingEngine:
         # replicated"); grads therefore return in device memory and move to
         # host in OffloadedOptimizer.step's device_get.  Host-space *inputs*
         # (the streamed params) are unaffected.
-        return jax.jit(step_fn)
+        # params are NOT donated: the host optimizer owns the update, and
+        # the same param buffers are re-read next step after in-place patch
+        return jax.jit(step_fn)  # lint: allow(jit-no-donate)
 
     def _train_batch_offloaded(self, placed, lr_scale=None
                                ) -> Dict[str, float]:

@@ -168,7 +168,9 @@ class ZenFlowOptimizer:
 
         self._select = jax.jit(select)
         self._gather_compact = jax.jit(gather_compact)
-        self._hot_step = jax.jit(hot_step)
+        # params stay live after the hot update (the cold-grad accumulator
+        # flush re-reads them), so donation would free buffers still in use
+        self._hot_step = jax.jit(hot_step)  # lint: allow(jit-no-donate)
         self._reapply_hot = jax.jit(reapply_hot)
 
     # -- selection ------------------------------------------------------

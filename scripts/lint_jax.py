@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""AST lint for Python-level JAX pitfalls in deepspeed_tpu/.
+
+The HLO analyzer (deepspeed_tpu/analysis/) audits what XLA emitted; this
+lint catches the Python-side mistakes *before* they reach a compile —
+fast (pure AST, no imports, no JAX) so scripts/t1.sh runs it as a
+pre-test gate.
+
+Rules:
+
+  jit-no-donate   a step/update-shaped function is jitted without
+                  donate_argnums/donate_argnames — the old buffers stay
+                  live across the call and the program double-buffers
+                  exactly the arrays that dominate memory
+  host-sync       a function passed to jax.jit contains a host
+                  synchronization (.block_until_ready(), .item(),
+                  np.asarray(...), jax.device_get(...)) — inside a traced
+                  function these either fail or silently force a device
+                  round-trip per call
+  debug-print     a bare jax.debug.print left in non-test code — it
+                  lowers to a host callback in every compiled program
+                  that traces through it
+
+A finding is suppressed by an inline marker naming its rule, e.g.::
+
+    self._update = jax.jit(update_step)  # lint: allow(jit-no-donate) — buffers reused by caller
+
+Usage: python scripts/lint_jax.py [paths...]   (default: deepspeed_tpu/)
+Exit status 1 if any finding survives.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+HOT_NAME_RE = re.compile(r"(^|_)(step|update)")
+HOST_SYNC_ATTRS = ("block_until_ready", "item")
+DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+_ALLOW_RE = re.compile(r"lint:\s*allow\(([\w\-, ]+)\)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """jax.jit / jit — the expression positions where a jit transform
+    appears (call target, decorator, or partial(jax.jit, ...) head)."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return isinstance(node.value, ast.Name) and node.value.id == "jax"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_call_info(call: ast.Call):
+    """If ``call`` invokes jax.jit, return (target_expr, has_donate);
+    handles jax.jit(f, ...) and functools.partial(jax.jit, ...)."""
+    fn = call.func
+    if _is_jax_jit(fn):
+        target = call.args[0] if call.args else None
+        has_donate = any(kw.arg in DONATE_KWARGS for kw in call.keywords)
+        return target, has_donate
+    if isinstance(fn, (ast.Name, ast.Attribute)) and \
+            (getattr(fn, "id", None) == "partial"
+             or getattr(fn, "attr", None) == "partial"):
+        if call.args and _is_jax_jit(call.args[0]):
+            has_donate = any(kw.arg in DONATE_KWARGS
+                             for kw in call.keywords)
+            return None, has_donate  # partial: target bound later
+    return NotImplemented, False
+
+
+class _FileLint:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.lines = source.splitlines()
+        self.findings: List[Finding] = []
+        self.tree = ast.parse(source, filename=path)
+        self.func_defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.func_defs.setdefault(node.name, node)
+
+    def _allowed(self, rule: str, lineno: int) -> bool:
+        """True if the source line (or the one above it, for wrapped
+        expressions) carries an allow marker naming ``rule``."""
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _ALLOW_RE.search(self.lines[ln - 1])
+                if m and rule in [r.strip()
+                                  for r in m.group(1).split(",")]:
+                    return True
+        return False
+
+    def _add(self, rule: str, lineno: int, message: str) -> None:
+        if not self._allowed(rule, lineno):
+            self.findings.append(Finding(self.path, lineno, rule, message))
+
+    # -- rule: jit-no-donate + collection of jitted function names -------
+
+    def _scan_jits(self) -> List[ast.FunctionDef]:
+        jitted: List[ast.FunctionDef] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                target, has_donate = _jit_call_info(node)
+                if target is NotImplemented:
+                    continue
+                name = target.id if isinstance(target, ast.Name) else None
+                if name and name in self.func_defs:
+                    jitted.append(self.func_defs[name])
+                if name and HOT_NAME_RE.search(name) and not has_donate:
+                    self._add(
+                        "jit-no-donate", node.lineno,
+                        f"jax.jit({name}) without donate_argnums — a "
+                        f"step/update hot path should donate its mutable "
+                        f"state or it double-buffers")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    is_plain = _is_jax_jit(dec)
+                    info = _jit_call_info(dec) if isinstance(dec, ast.Call) \
+                        else (NotImplemented, False)
+                    if not is_plain and info[0] is NotImplemented:
+                        continue
+                    jitted.append(node)
+                    has_donate = (not is_plain) and info[1]
+                    if HOT_NAME_RE.search(node.name) and not has_donate:
+                        self._add(
+                            "jit-no-donate", node.lineno,
+                            f"@jax.jit on {node.name} without "
+                            f"donate_argnums")
+        return jitted
+
+    # -- rule: host-sync inside jitted functions -------------------------
+
+    def _scan_host_syncs(self, jitted: List[ast.FunctionDef]) -> None:
+        seen = set()
+        for fn in jitted:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr in HOST_SYNC_ATTRS and not node.args:
+                        self._add(
+                            "host-sync", node.lineno,
+                            f".{f.attr}() inside jitted function "
+                            f"{fn.name!r} forces a device round-trip per "
+                            f"call (or fails under trace)")
+                    elif f.attr == "asarray" and \
+                            isinstance(f.value, ast.Name) and \
+                            f.value.id in ("np", "numpy"):
+                        self._add(
+                            "host-sync", node.lineno,
+                            f"np.asarray(...) inside jitted function "
+                            f"{fn.name!r} materializes on host; use "
+                            f"jnp.asarray")
+                    elif f.attr == "device_get" and \
+                            isinstance(f.value, ast.Name) and \
+                            f.value.id == "jax":
+                        self._add(
+                            "host-sync", node.lineno,
+                            f"jax.device_get(...) inside jitted function "
+                            f"{fn.name!r}")
+
+    # -- rule: bare jax.debug.print --------------------------------------
+
+    def _scan_debug_prints(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "print":
+                v = node.func.value
+                if isinstance(v, ast.Attribute) and v.attr == "debug" and \
+                        isinstance(v.value, ast.Name) and v.value.id == "jax":
+                    self._add(
+                        "debug-print", node.lineno,
+                        "bare jax.debug.print in non-test code — it "
+                        "compiles a host callback into every program "
+                        "tracing through it")
+
+    def run(self) -> List[Finding]:
+        jitted = self._scan_jits()
+        self._scan_host_syncs(jitted)
+        self._scan_debug_prints()
+        return self.findings
+
+
+def lint_source(source: str, path: str = "<memory>") -> List[Finding]:
+    """Lint one source string (unit-test entry point)."""
+    return _FileLint(path, source).run()
+
+
+def lint_paths(paths: List[Path]) -> List[Finding]:
+    findings: List[Finding] = []
+    for root in paths:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for f in files:
+            try:
+                findings.extend(lint_source(f.read_text(), str(f)))
+            except SyntaxError as e:
+                findings.append(Finding(str(f), e.lineno or 0, "parse",
+                                        f"syntax error: {e.msg}"))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    repo = Path(__file__).resolve().parent.parent
+    paths = [Path(a) for a in argv] or [repo / "deepspeed_tpu"]
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"lint_jax: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,6 +11,14 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
+echo "== t1: jax lint gate =="
+# pure-AST lint (no JAX import, sub-second): jitted step/update functions
+# must donate, no host syncs inside jitted bodies, no stray jax.debug.print
+if ! timeout -k 10 60 python scripts/lint_jax.py; then
+    echo "t1: LINT FAILED (scripts/lint_jax.py)" >&2
+    exit 2
+fi
+
 echo "== t1: collection gate =="
 if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' --collect-only \

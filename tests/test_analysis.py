@@ -1,0 +1,467 @@
+"""Unit tests for deepspeed_tpu.analysis on synthetic HLO fixtures.
+
+Every pass is exercised against hand-written HLO text (grammar matching
+what ``compiled.as_text()`` prints on this toolchain), so the parser and
+passes are tested independently of any compilation.  The compiled-program
+gate lives in tests/test_analysis_gate.py.
+"""
+
+import importlib.util
+import os
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.analysis import (AnalysisContext, BudgetError,
+                                    DonationAuditPass, DtypePromotionPass,
+                                    HostSyncPass, ReplicatedTensorPass,
+                                    UnknownDtypeError, analyze,
+                                    check_budgets, collective_bytes,
+                                    collective_census, default_budgets_path,
+                                    dtype_nbytes, load_budgets, parse_hlo)
+from deepspeed_tpu.analysis.programs import available_programs
+
+MiB = 1 << 20
+
+# A train-step-shaped module: 2 materialized aliases (params 0, 1), one
+# donated-but-unaliased buffer (param 2), one large replicated undonated
+# param (param 3); a deduped channel pair, an async all-gather pair, a
+# while loop whose body holds a collective, and an attrs mention of
+# "all-gather" that must NOT count as an instruction.
+TRAIN_FIXTURE = textwrap.dedent("""\
+    HloModule jit_train_step, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (1, {}, must-alias) }, buffer_donor={ (2, {}) }, num_partitions=8
+
+    %add (a.1: f32[], b.1: f32[]) -> f32[] {
+      %a.1 = f32[] parameter(0)
+      %b.1 = f32[] parameter(1)
+      ROOT %add.2 = f32[] add(f32[] %a.1, f32[] %b.1)
+    }
+
+    %wbody (wp: (s32[], f32[1024])) -> (s32[], f32[1024]) {
+      %wp = (s32[], f32[1024]) parameter(0)
+      %it = s32[] get-tuple-element((s32[], f32[1024]) %wp), index=0
+      %buf = f32[1024] get-tuple-element((s32[], f32[1024]) %wp), index=1
+      %loop-ar = f32[1024] all-reduce(f32[1024] %buf), channel_id=7, replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
+      ROOT %wtup = (s32[], f32[1024]) tuple(s32[] %it, f32[1024] %loop-ar)
+    }
+
+    %wcond (wc: (s32[], f32[1024])) -> pred[] {
+      %wc = (s32[], f32[1024]) parameter(0)
+      %it.1 = s32[] get-tuple-element((s32[], f32[1024]) %wc), index=0
+      %lim = s32[] constant(4)
+      ROOT %lt = pred[] compare(s32[] %it.1, s32[] %lim), direction=LT
+    }
+
+    ENTRY %main.42_spmd (param.0: f32[1048576], param.1: bf16[2048,1024], param.2: f32[262144], param.3: f32[524288]) -> (f32[1048576], bf16[2048,1024]) {
+      %param.0 = f32[1048576] parameter(0), sharding={devices=[8]<=[8]}
+      %param.1 = bf16[2048,1024] parameter(1), sharding={devices=[8,1]<=[8]}
+      %param.2 = f32[262144] parameter(2), sharding={devices=[8]<=[8]}
+      %param.3 = f32[524288] parameter(3), sharding={replicated}
+      %slice.1 = f32[1024] slice(f32[1048576] %param.0), slice={[0:1024]}
+      %grad-ar = f32[1024] all-reduce(f32[1024] %slice.1), channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add, metadata={op_name="transpose(all-gather)"}
+      %grad-ar.dup = f32[1024] all-reduce(f32[1024] %slice.1), channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
+      %slice.2 = bf16[512] slice(bf16[2048,1024] %param.1), slice={[0:512]}
+      %ag-start = (bf16[512], bf16[4096]) all-gather-start(bf16[512] %slice.2), channel_id=2, replica_groups=[1,8]<=[8], dimensions={0}
+      %ag-done = bf16[4096] all-gather-done((bf16[512], bf16[4096]) %ag-start)
+      %zero = s32[] constant(0)
+      %init = (s32[], f32[1024]) tuple(s32[] %zero, f32[1024] %grad-ar)
+      %loop = (s32[], f32[1024]) while((s32[], f32[1024]) %init), condition=%wcond, body=%wbody
+      ROOT %out = (f32[1048576], bf16[2048,1024]) tuple(f32[1048576] %param.0, bf16[2048,1024] %param.1)
+    }
+""")
+
+
+# ---------------------------------------------------------------------------
+# IR / parser
+# ---------------------------------------------------------------------------
+
+
+def test_parse_module_structure():
+    mod = parse_hlo(TRAIN_FIXTURE)
+    assert mod.name == "jit_train_step"
+    assert set(mod.computations) == {"add", "wbody", "wcond", "main.42_spmd"}
+    assert mod.entry is not None and mod.entry.name == "main.42_spmd"
+    assert set(mod.entry.parameters()) == {0, 1, 2, 3}
+    # alias header: params 0 and 1 materialized, param 2 donor-only
+    assert {(a.param_number, a.kind) for a in mod.input_output_aliases} == \
+        {(0, "may-alias"), (1, "must-alias")}
+    assert mod.buffer_donors == [(2, ())]
+    # while membership is transitive over called computations
+    assert mod.loop_computations() >= {"wbody", "wcond", "add"}
+    assert "main.42_spmd" not in mod.loop_computations()
+
+
+def test_parse_shapes_and_layouts():
+    mod = parse_hlo(TRAIN_FIXTURE)
+    p1 = mod.entry.parameters()[1]
+    assert p1.shape.dtype == "bf16" and p1.shape.dims == (2048, 1024)
+    assert p1.shape.nbytes == 2048 * 1024 * 2
+    ag_start = mod.find("all-gather-start")[0]
+    assert ag_start.shape.is_tuple
+    assert [leaf.dims for leaf in ag_start.shape.leaves()] == [(512,), (4096,)]
+    assert ag_start.channel_id == 2
+    assert mod.entry.parameters()[3].sharding == "{replicated}"
+
+
+def test_dtype_bytes_fp8_and_subbyte():
+    """The old compile_evidence._DTYPE_BYTES silently dropped fp8 dtypes;
+    the analyzer accounts for them exactly and errors on unknowns."""
+    assert dtype_nbytes("f8e4m3fn", 1000) == 1000
+    assert dtype_nbytes("f8e5m2", 1000) == 1000
+    assert dtype_nbytes("s4", 1000) == 500  # packed int4
+    assert dtype_nbytes("f4e2m1fn", 3) == 2  # sub-byte rounds up
+    assert dtype_nbytes("bf16", 10) == 20
+    with pytest.raises(UnknownDtypeError, match="DTYPE_BITS"):
+        dtype_nbytes("f99x", 1)
+
+
+def test_fp8_collective_bytes_from_fragment():
+    """Quantized-wire collectives (fp8 / int4 payloads) must be counted —
+    this is the regression the fp8 fix closes."""
+    frag = textwrap.dedent("""\
+        %q-ar = f8e4m3fn[1000] all-reduce(f8e4m3fn[1000] %x), channel_id=1, replica_groups={{0,1}}, to_apply=%add
+        %q-ag = s4[2048] all-gather(s4[1024] %w), channel_id=2, dimensions={0}
+    """)
+    b = collective_bytes(frag)
+    assert b["all-reduce"] == 1000
+    assert b["all-gather"] == 1024  # 2048 int4 codes = 1024 bytes
+
+
+def test_unknown_dtype_in_collective_is_loud():
+    frag = "%z = f6e3m2[64] all-reduce(f6e3m2[64] %x), channel_id=1\n"
+    with pytest.raises(UnknownDtypeError):
+        collective_bytes(frag)
+
+
+# ---------------------------------------------------------------------------
+# collective census
+# ---------------------------------------------------------------------------
+
+
+def test_census_counts_dedup_async_and_loops():
+    census = collective_census(TRAIN_FIXTURE)
+    # channel-id dedup: grad-ar.dup shares channel 1 → counted once;
+    # the loop body's channel-7 all-reduce is distinct
+    assert census["collectives"] == {"all-reduce": 2, "all-gather": 1}
+    # async pair counts once, tallied as async
+    assert census["async_started"] == {"all-gather": 1}
+    assert census["in_loop_body"] == {"all-reduce": 1}
+    # bytes: sync all-reduce 4096 + loop all-reduce 4096 (dup deduped);
+    # all-gather bytes at the DONE (bf16[4096] = 8192), not the start's
+    # backend tuple
+    assert census["bytes"] == {"all-reduce": 8192, "all-gather": 8192}
+    assert census["total"] == 3
+    assert census["total_async"] == 1
+    assert census["total_bytes"] == 16384
+
+
+def test_census_ignores_attr_mentions():
+    """An op name inside metadata/replica_groups attrs is not an
+    instruction: only the syntactic opcode slot counts."""
+    census = collective_census(TRAIN_FIXTURE)
+    # the metadata op_name="transpose(all-gather)" on %grad-ar must not
+    # inflate the all-gather count past the single real async pair
+    assert census["collectives"]["all-gather"] == 1
+    frag = ('%f = f32[8] fusion(f32[8] %x), kind=kLoop, '
+            'metadata={op_name="all-reduce-bwd" source_file="x.py"}\n')
+    assert collective_census(frag)["collectives"] == {}
+
+
+def test_census_done_lines_not_double_counted():
+    frag = textwrap.dedent("""\
+        %rs-start = ((f32[64]), f32[8]) reduce-scatter-start(f32[64] %g), channel_id=3, dimensions={0}, to_apply=%add
+        %rs-done = f32[8] reduce-scatter-done(((f32[64]), f32[8]) %rs-start), channel_id=3
+    """)
+    census = collective_census(frag)
+    assert census["collectives"] == {"reduce-scatter": 1}
+    assert census["bytes"] == {"reduce-scatter": 32}
+
+
+# ---------------------------------------------------------------------------
+# donation audit
+# ---------------------------------------------------------------------------
+
+
+def test_donation_audit_aliases_donors_and_stragglers():
+    mod = parse_hlo(TRAIN_FIXTURE)
+    out = DonationAuditPass().run(mod, AnalysisContext())
+    assert out["n_aliases"] == 2
+    # param.0 f32[1M] + param.1 bf16[2048,1024] = 4 MiB each
+    assert out["aliased_bytes"] == 8 * MiB
+    assert out["n_donor_unaliased"] == 1
+    assert out["donor_unaliased_bytes"] == 1 * MiB  # param.2 f32[256k]
+    assert out["n_large_unaliased"] == 1
+    assert out["large_unaliased"][0]["param"] == 3
+    assert out["large_unaliased"][0]["bytes"] == 2 * MiB
+
+
+def test_donation_alias_fraction_against_intent():
+    mod = parse_hlo(TRAIN_FIXTURE)
+    ctx = AnalysisContext(donated_intent_bytes=9 * MiB)
+    out = DonationAuditPass().run(mod, ctx)
+    assert out["donated_intent_bytes"] == 9 * MiB
+    assert out["alias_fraction"] == pytest.approx(8 / 9, abs=1e-3)
+    # without intent there is no fraction to report
+    assert "alias_fraction" not in DonationAuditPass().run(
+        mod, AnalysisContext())
+
+
+# ---------------------------------------------------------------------------
+# host-sync detector
+# ---------------------------------------------------------------------------
+
+HOST_SYNC_FIXTURE = textwrap.dedent("""\
+    HloModule jit_leaky
+
+    ENTRY %main (p0: f32[16]) -> f32[16] {
+      %p0 = f32[16] parameter(0)
+      %tok = token[] after-all()
+      %inf = ((f32[16], u32[]), token[]) infeed(token[] %tok)
+      %send = (f32[16], u32[], token[]) send(f32[16] %p0, token[] %tok), channel_id=3, is_host_transfer=true
+      %send-done = token[] send-done((f32[16], u32[], token[]) %send), channel_id=3, is_host_transfer=true
+      %cp = f32[16]{0:S(5)} copy(f32[16] %p0)
+      %cc = f32[16] custom-call(f32[16] %p0), custom_call_target="xla_ffi_python_cpu_callback", api_version=API_VERSION_TYPED_FFI
+      ROOT %out = f32[16] add(f32[16] %p0, f32[16] %p0)
+    }
+""")
+
+
+def test_host_sync_detection():
+    mod = parse_hlo(HOST_SYNC_FIXTURE)
+    out = HostSyncPass().run(mod, AnalysisContext())
+    # send-done is folded into its send; device-to-device sends (no
+    # is_host_transfer) would not count at all
+    assert out["by_kind"] == {"infeed": 1, "host_send": 1, "host_copy": 1,
+                              "callback:xla_ffi_python_cpu_callback": 1}
+    assert out["count"] == 4
+    assert out["in_loop_body"] == 0
+
+
+def test_host_sync_clean_program_is_zero():
+    out = HostSyncPass().run(parse_hlo(TRAIN_FIXTURE), AnalysisContext())
+    assert out["count"] == 0 and out["by_kind"] == {}
+
+
+# ---------------------------------------------------------------------------
+# dtype-promotion lint
+# ---------------------------------------------------------------------------
+
+PROMOTION_FIXTURE = textwrap.dedent("""\
+    HloModule jit_promoted
+
+    ENTRY %main (p0: bf16[64,64], p1: f32[64,64]) -> f32[64,64] {
+      %p0 = bf16[64,64] parameter(0)
+      %p1 = f32[64,64] parameter(1)
+      %cv = f32[64,64] convert(bf16[64,64] %p0)
+      %cv-small = f32[8] convert(bf16[8] %glue)
+      %dot-mixed = f32[64,64] dot(bf16[64,64] %p0, bf16[64,64] %p0), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+      ROOT %dot-f32 = f32[64,64] dot(f32[64,64] %cv, f32[64,64] %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+    }
+""")
+
+
+def test_dtype_promotion_lint():
+    mod = parse_hlo(PROMOTION_FIXTURE)
+    out = DtypePromotionPass().run(mod, AnalysisContext(compute_dtype="bf16"))
+    # one large bf16→f32 convert (the f32[8] glue is under the element
+    # floor); the bf16×bf16→f32 dot is mixed-precision accumulation and
+    # does NOT count — only the all-f32 contraction does
+    assert out["f32_upcast_converts"] == 1
+    assert out["f32_upcast_bytes"] == 64 * 64 * 4
+    assert out["f32_dots"] == 1
+    assert out["examples"] == ["convert:cv", "dot:dot-f32"]
+
+
+def test_dtype_promotion_skips_without_anchor():
+    out = DtypePromotionPass().run(parse_hlo(PROMOTION_FIXTURE),
+                                   AnalysisContext())
+    assert "skipped" in out
+
+
+# ---------------------------------------------------------------------------
+# replicated-tensor detector
+# ---------------------------------------------------------------------------
+
+
+def test_replication_detector():
+    mod = parse_hlo(TRAIN_FIXTURE)
+    out = ReplicatedTensorPass().run(mod, AnalysisContext(mesh_devices=8))
+    # param.3 is {replicated} and 2 MiB; params 0-2 carry devices=[...]
+    assert out["n_replicated_params"] == 1
+    assert out["replicated_params"][0]["param"] == 3
+    assert out["replicated_param_bytes"] == 2 * MiB
+
+
+def test_replication_counts_large_constants():
+    frag = textwrap.dedent("""\
+        ENTRY %main (p0: f32[8]) -> f32[8] {
+          %p0 = f32[8] parameter(0), sharding={devices=[8]<=[8]}
+          %big = f32[524288] constant({...})
+          %tiny = s32[] constant(4)
+          ROOT %o = f32[8] add(f32[8] %p0, f32[8] %p0)
+        }
+    """)
+    out = ReplicatedTensorPass().run(parse_hlo(frag),
+                                     AnalysisContext(mesh_devices=8))
+    assert out["n_large_constants"] == 1
+    assert out["large_constant_bytes"] == 2 * MiB
+
+
+def test_replication_skips_single_device():
+    out = ReplicatedTensorPass().run(parse_hlo(TRAIN_FIXTURE),
+                                     AnalysisContext(mesh_devices=1))
+    assert "skipped" in out
+
+
+# ---------------------------------------------------------------------------
+# budgets
+# ---------------------------------------------------------------------------
+
+
+def _report(**ctx_kw):
+    return analyze(TRAIN_FIXTURE, AnalysisContext(
+        program="fixture", compute_dtype="bf16", mesh_devices=8, **ctx_kw))
+
+
+def test_budget_pass_and_violations():
+    report = _report(donated_intent_bytes=9 * MiB)
+    ok = {
+        "max_collectives": {"all-reduce": 2, "all-gather": 1, "total": 3},
+        "max_collective_bytes": 20_000,
+        "max_host_syncs": 0,
+        "min_io_aliases": 2,
+        "max_donor_unaliased_bytes": MiB,
+        "min_alias_fraction": 0.85,
+        "max_replicated_large_params": 1,
+    }
+    assert check_budgets(report, ok, "fixture") == []
+    tight = {
+        "max_collectives": {"all-reduce": 1},       # actual 2
+        "max_collective_bytes": 1_000,              # actual 16384
+        "min_io_aliases": 3,                        # actual 2
+        "max_donor_unaliased_bytes": 0,             # actual 1 MiB
+        "min_alias_fraction": 0.95,                 # actual ~0.889
+        "max_replicated_large_params": 0,           # actual 1
+    }
+    violations = check_budgets(report, tight, "fixture")
+    checks = {v.check for v in violations}
+    assert checks == {"collectives.all-reduce", "collectives.total_bytes",
+                      "donation.n_aliases", "donation.donor_unaliased_bytes",
+                      "donation.alias_fraction",
+                      "replication.n_replicated_params"}
+    assert all(v.program == "fixture" for v in violations)
+
+
+def test_budget_loop_collective_ceiling():
+    report = _report()
+    v = check_budgets(report, {"max_collectives_in_loops": 0}, "fixture")
+    assert [x.check for x in v] == ["collectives.in_loop_body"]
+    assert v[0].actual == 1
+
+
+def test_budget_never_passes_vacuously():
+    # replication pass skips on a 1-device context; a budget that needs it
+    # must be a hard error, not a silent pass
+    report = analyze(TRAIN_FIXTURE, AnalysisContext(mesh_devices=1))
+    with pytest.raises(BudgetError, match="vacuously"):
+        check_budgets(report, {"max_replicated_large_params": 0}, "fixture")
+
+
+def test_budget_alias_fraction_requires_intent():
+    report = _report()  # no donated_intent_bytes
+    with pytest.raises(BudgetError, match="donated_intent_bytes"):
+        check_budgets(report, {"min_alias_fraction": 0.5}, "fixture")
+
+
+def test_budget_file_rejects_unknown_keys(tmp_path):
+    bad = tmp_path / "budgets.toml"
+    bad.write_text('[programs."p"]\nmax_colectives_typo = 3\n')
+    with pytest.raises(BudgetError, match="unknown budget key"):
+        load_budgets(str(bad))
+
+
+def test_shipped_budgets_cover_all_flagship_programs():
+    budgets = load_budgets()
+    assert os.path.exists(default_budgets_path())
+    assert set(budgets) == set(available_programs())
+    # every flagship program bans host syncs outright
+    assert all(b.get("max_host_syncs") == 0 for b in budgets.values())
+
+
+# ---------------------------------------------------------------------------
+# scripts/lint_jax.py (loaded by path — scripts/ is not a package)
+# ---------------------------------------------------------------------------
+
+
+def _lint_mod():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                        "lint_jax.py")
+    spec = importlib.util.spec_from_file_location("lint_jax", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclasses resolves via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lint_jit_without_donate():
+    lint = _lint_mod()
+    src = textwrap.dedent("""\
+        import jax
+
+        def train_step(state, batch):
+            return state
+
+        f = jax.jit(train_step)
+    """)
+    rules = [f.rule for f in lint.lint_source(src)]
+    assert rules == ["jit-no-donate"]
+    ok = src.replace("jax.jit(train_step)",
+                     "jax.jit(train_step, donate_argnums=(0,))")
+    assert lint.lint_source(ok) == []
+
+
+def test_lint_allow_marker_suppresses():
+    lint = _lint_mod()
+    src = textwrap.dedent("""\
+        import jax
+
+        def train_step(state, batch):
+            return state
+
+        f = jax.jit(train_step)  # lint: allow(jit-no-donate) — caller reuses
+    """)
+    assert lint.lint_source(src) == []
+
+
+def test_lint_host_sync_inside_jit():
+    lint = _lint_mod()
+    src = textwrap.dedent("""\
+        import jax
+        import numpy as np
+
+        def fwd(x):
+            y = x.block_until_ready()
+            z = np.asarray(y)
+            return z.item()
+
+        f = jax.jit(fwd)
+    """)
+    rules = [f.rule for f in lint.lint_source(src)]
+    assert rules.count("host-sync") == 3
+    # the same body NOT passed to jit is fine (host-side helper)
+    assert lint.lint_source(src.replace("f = jax.jit(fwd)", "")) == []
+
+
+def test_lint_debug_print():
+    lint = _lint_mod()
+    src = "import jax\njax.debug.print('x={}', 1)\n"
+    assert [f.rule for f in lint.lint_source(src)] == ["debug-print"]
+
+
+def test_lint_repo_tree_is_clean():
+    """The gate scripts/t1.sh runs must hold on the current tree."""
+    lint = _lint_mod()
+    pkg = os.path.join(os.path.dirname(__file__), os.pardir, "deepspeed_tpu")
+    findings = lint.lint_paths([__import__("pathlib").Path(pkg)])
+    assert findings == [], "\n".join(str(f) for f in findings)

@@ -1,0 +1,35 @@
+"""Tier-1 budget gate: compile every budgeted flagship program on the
+virtual 8-device mesh and hold its analysis report to the declarative
+ceilings in deepspeed_tpu/analysis/budgets.toml.
+
+This is the CI face of ``python -m deepspeed_tpu.analysis``: a collective
+count/byte regression, a donation that stops materializing as an
+input-output alias, a new host sync, or a fresh f32 promotion in any
+flagship program fails HERE, with the violating check named — not in a
+paper claim three PRs later.  Raising a ceiling is a reviewed edit to
+budgets.toml, not a code change.
+"""
+
+import pytest
+
+from deepspeed_tpu.analysis import analyze, check_budgets, load_budgets
+from deepspeed_tpu.analysis.programs import available_programs, build_program
+
+BUDGETS = load_budgets()
+
+
+def test_budgets_and_registry_agree():
+    assert set(BUDGETS) == set(available_programs())
+
+
+@pytest.mark.parametrize("name", sorted(BUDGETS))
+def test_program_within_budget(devices, name):
+    artifact = build_program(name)
+    report = analyze(artifact.hlo_text, artifact.ctx)
+    violations = check_budgets(report, BUDGETS[name], name)
+    assert not violations, "budget violations:\n" + "\n".join(
+        str(v) for v in violations)
+    # the report must rest on real pass output, not vacuous skips, for
+    # every dimension the budget constrains (check_budgets raises
+    # BudgetError otherwise — reaching here means the gate is live)
+    assert report["passes"]["collectives"]["total"] >= 0

@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu
-from deepspeed_tpu.profiling.compile_evidence import hlo_collective_census
+from deepspeed_tpu.analysis import collective_census
 from tests.simple_model import tiny_lm_spec
 
 BASE = {
@@ -24,7 +24,7 @@ def _census(cfg):
     batch = {"input_ids": np.zeros((engine.train_batch_size, 32), np.int32)}
     placed = engine._place_batch(batch)
     hlo = engine._train_step.lower(engine.state, placed).compile().as_text()
-    return engine, hlo_collective_census(hlo)
+    return engine, collective_census(hlo)
 
 
 @pytest.mark.parametrize("stage", [0, 1])

@@ -196,9 +196,12 @@ def test_qwz_gathers_ship_int8(devices):
     batch = copy_task_batch(np.random.default_rng(0),
                             engine.train_batch_size, 32)
     placed = engine._place_batch(batch)
+    from deepspeed_tpu.analysis import parse_hlo
+
     hlo = engine._train_step.lower(engine.state, placed).compile().as_text()
-    gathers = [ln for ln in hlo.splitlines() if "all-gather" in ln]
-    s8 = [ln for ln in gathers if "s8[" in ln]
+    gathers = parse_hlo(hlo).find("all-gather")
+    s8 = [g for g in gathers
+          if any(leaf.dtype == "s8" for leaf in g.shape.leaves())]
     assert s8, f"no int8 all-gathers found among {len(gathers)} gathers"
     # no large-operand full-precision weight gathers remain: any f32/bf16
     # all-gather should be scales-sized (≤ 1/64 of codes volume) or params

@@ -194,8 +194,7 @@ def test_hlo_no_base_grad_collectives(devices, stage):
     """The gradient reduction buckets hold EXACTLY the adapter elements —
     a frozen-base gradient leaking into the reduction would inflate the
     bucket plan and the collective payload past the adapter total."""
-    from deepspeed_tpu.profiling.compile_evidence import (
-        hlo_collective_bytes, hlo_collective_census)
+    from deepspeed_tpu.analysis import collective_bytes, collective_census
 
     engine = _engine(zero_optimization={"stage": stage})
     adapter_elems = sum(
@@ -211,8 +210,8 @@ def test_hlo_no_base_grad_collectives(devices, stage):
     batch = {"input_ids": np.zeros((engine.train_batch_size, 32), np.int32)}
     placed = engine._place_batch(batch)
     hlo = engine._train_step.lower(engine.state, placed).compile().as_text()
-    census = hlo_collective_census(hlo)
-    nbytes = hlo_collective_bytes(hlo)
+    census = collective_census(hlo)
+    nbytes = collective_bytes(hlo)
     # every reduction payload fits in the adapter total (f32) — the frozen
     # base (≥10× larger) cannot be hiding in any collective
     grad_bytes = sum(v for k, v in nbytes.items()

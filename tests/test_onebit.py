@@ -106,7 +106,7 @@ def test_onebit_converges_vs_exact(devices):
 def test_onebit_wire_volume_shrinks(devices):
     """From the COMPILED HLO: the 1-bit step's collective volume must be a
     fraction of the exact step's — the wire, not a numerics simulation."""
-    from deepspeed_tpu.profiling.compile_evidence import hlo_collective_bytes
+    from deepspeed_tpu.analysis import collective_bytes
 
     # stage 0: params replicated → NO ZeRO-1 param all-gather in either
     # program, so every collective byte is gradient-reduction traffic
@@ -123,8 +123,8 @@ def test_onebit_wire_volume_shrinks(devices):
     hlo_1bit = onebit._train_step_onebit.lower(
         onebit.state, onebit._place_batch(batch), residuals,
         None).compile().as_text()
-    b_exact = hlo_collective_bytes(hlo_exact)
-    b_1bit = hlo_collective_bytes(hlo_1bit)
+    b_exact = collective_bytes(hlo_exact)
+    b_1bit = collective_bytes(hlo_1bit)
     # gradient traffic = everything except tiny metric reductions; compare
     # totals (same model, same batch — the only difference is the reduction)
     total_exact = sum(b_exact.values())
