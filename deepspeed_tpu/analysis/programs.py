@@ -170,8 +170,13 @@ def _decode_v2_program() -> ProgramArtifact:
 
     cfg = _subject_cfg()
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    # the audited engine has the prefix cache ON: sharing is host-side
+    # block-table indirection only, so the compiled decode program must be
+    # unchanged — zero host syncs, zero collectives, full cache aliasing
+    # (the budget enforces exactly that)
     v2 = V2Config(max_tokens_per_step=64, max_seqs=4, block_size=8,
-                  num_blocks=64, max_blocks_per_seq=8, dtype="bfloat16")
+                  num_blocks=64, max_blocks_per_seq=8, dtype="bfloat16",
+                  enable_prefix_cache=True)
     eng = InferenceEngineV2(cfg, params, v2)
     seqs = v2.max_seqs
     tokens = np.zeros((seqs,), np.int32)

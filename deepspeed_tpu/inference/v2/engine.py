@@ -48,6 +48,12 @@ class V2Config:
     num_blocks: int = 512
     max_blocks_per_seq: int = 32
     dtype: str = "bfloat16"
+    # cross-request KV prefix cache (inference/v2/prefix_cache.py): finished
+    # sequences donate full prefix blocks into a radix tree; new requests
+    # skip prefill for the longest cached prefix via block-table sharing
+    enable_prefix_cache: bool = False
+    prefix_cache_min_tokens: int = 0  # min shareable prefix to take a hit
+    prefix_eviction: str = "lru"  # "lru" | "none"
 
 
 # ---------------------------------------------------------------------------
@@ -258,6 +264,22 @@ def build_multi_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config,
     return jax.jit(fwd, donate_argnums=(1,))
 
 
+def build_cow_copy():
+    """Copy one KV block to another across every layer — the copy-on-write
+    fork for partial-block prefix sharing.  ``src``/``dst`` are traced int32
+    scalars so every (src, dst) pair reuses one compiled program; positions
+    past the shared prefix carry stale KV that the paged kernels never read
+    (prefill overwrites the chunk before attention, and keys beyond
+    ``context_lens`` are masked)."""
+
+    def copy_block(caches, src, dst):
+        k, v = caches["k"], caches["v"]
+        return {"k": k.at[:, dst].set(k[:, src]),
+                "v": v.at[:, dst].set(v[:, src])}
+
+    return jax.jit(copy_block, donate_argnums=(0,))
+
+
 def _decode_body(params, caches, token_ids, position_ids, block_tables,
                  context_lens, model_cfg, v2):
     """Single-token decode shared by build_decode_forward and the multi-step
@@ -361,6 +383,17 @@ class InferenceEngineV2:
         # one block reserved as write-scratch for padded tokens
         self.kv = KVCacheManager(self.cfg.num_blocks - 1, self.cfg.block_size,
                                  self.cfg.max_blocks_per_seq)
+        self.prefix_cache = None
+        self._cow_copy = None
+        if self.cfg.enable_prefix_cache:
+            from .prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                self.kv.allocator, self.cfg.block_size,
+                min_prefix_tokens=self.cfg.prefix_cache_min_tokens,
+                eviction=self.cfg.prefix_eviction)
+            self.kv.prefix_cache = self.prefix_cache
+            self._cow_copy = build_cow_copy()
         self.builder = RaggedBatchBuilder(self.cfg.max_tokens_per_step,
                                           self.cfg.max_seqs,
                                           self.cfg.max_blocks_per_seq)
@@ -393,6 +426,41 @@ class InferenceEngineV2:
     @property
     def free_blocks(self) -> int:
         return self.kv.allocator.free_blocks
+
+    @property
+    def evictable_blocks(self) -> int:
+        """Prefix-tree blocks no live sequence shares (refcount 1)."""
+        return self.prefix_cache.evictable_blocks if self.prefix_cache else 0
+
+    @property
+    def reclaimable_blocks(self) -> int:
+        """Evictable blocks admission control may treat as free (0 when
+        the cache is off or the eviction policy is 'none')."""
+        return (self.prefix_cache.reclaimable_blocks
+                if self.prefix_cache else 0)
+
+    @property
+    def pinned_blocks(self) -> int:
+        """Allocated blocks some live owner still needs — computed from
+        allocator refcounts (NOT as total - free - evictable) so the leak
+        invariant ``free + evictable + pinned == total`` is a real check."""
+        alloc = self.kv.allocator
+        live = sum(1 for b in range(alloc.num_blocks) if alloc.refcount(b) > 0)
+        return live - self.evictable_blocks
+
+    def prefix_stats(self) -> Dict[str, float]:
+        """Prefix-cache counters + block-accounting gauges for serving
+        metrics; all-zero (enabled=0) when the cache is off."""
+        stats: Dict[str, float] = {
+            "enabled": 0, "lookups": 0, "hits": 0, "hit_rate": 0.0,
+            "prefill_tokens_skipped": 0, "evictions": 0, "cow_copies": 0,
+            "cached_blocks": 0, "shared_blocks": 0, "evictable_blocks": 0,
+        }
+        if self.prefix_cache is not None:
+            stats.update(self.prefix_cache.stats())
+            stats["enabled"] = 1
+        stats["pinned_blocks"] = self.pinned_blocks
+        return stats
 
     @property
     def num_running(self) -> int:
@@ -433,7 +501,10 @@ class InferenceEngineV2:
                     f"all {self.cfg.max_seqs} sequence slots in use "
                     f"({self.num_running} running, {self.num_waiting} "
                     "waiting)")
-            avail = self.free_blocks - self._reserved_by_waiting()
+            # evictable prefix-cache blocks count as free: admission must
+            # not starve on a warm cache (the scheduler evicts on demand)
+            avail = (self.free_blocks + self.reclaimable_blocks
+                     - self._reserved_by_waiting())
             if self._blocks_for(need) > avail:
                 raise AdmissionError(
                     f"KV block pool exhausted: request needs "
@@ -464,10 +535,23 @@ class InferenceEngineV2:
         # pool can be exhausted by half-admitted requests and livelock.
         while self.waiting and budget > 0 and len(picks) < self.cfg.max_seqs:
             seq = self.waiting[0]
+            if (self.prefix_cache is not None and not seq.blocks
+                    and seq.seen_tokens == 0):
+                self._match_prefix(seq)
             n = min(seq.cur_len - seq.seen_tokens, budget)
             total_needed = (seq.cur_len - seq.seen_tokens) + seq.max_new_tokens
             if n <= 0 or not self.kv.ensure_capacity(seq, total_needed):
+                if seq.blocks or seq.seen_tokens:
+                    # roll the prefix match back — waiting sequences hold
+                    # no blocks (admission-reservation invariant); the
+                    # lookup is uncounted so stalls don't skew hit rate
+                    self.kv.release(seq)
+                    seq.seen_tokens = 0
+                    self.prefix_cache.lookups -= 1
                 break
+            if seq.seen_tokens:
+                self.prefix_cache.hits += 1
+                self.prefix_cache.tokens_skipped += seq.seen_tokens
             self.waiting.popleft()
             self.running[seq.uid] = seq
             self.table.admit(seq)
@@ -475,6 +559,39 @@ class InferenceEngineV2:
             picks.append((seq, n))
             budget -= n
         return picks
+
+    def _match_prefix(self, seq: SequenceDescriptor) -> None:
+        """Seed a waiting sequence's block table from the radix tree.
+
+        Full shared blocks are pure block-table indirection (the jitted
+        forwards never change); a partial-block divergence forks a private
+        copy-on-write block on device.  ``seen_tokens`` advances past the
+        cached prefix so SplitFuse prefill starts at the first uncached
+        token.  The scheduler rolls this back via ``kv.release`` if the
+        sequence still cannot be admitted."""
+        m = self.prefix_cache.match(seq.tokens, limit=seq.cur_len - 1)
+        if m is None:
+            return
+        blocks = list(m.blocks)
+        skipped = m.tokens
+        if m.cow_src is not None:
+            alloc = self.kv.allocator
+            if alloc.free_blocks == 0:
+                self.prefix_cache.evict(1)
+            if (alloc.free_blocks > 0
+                    and len(blocks) < self.cfg.max_blocks_per_seq):
+                (dst,) = alloc.allocate(1)
+                self.caches = self._cow_copy(
+                    self.caches, jnp.int32(m.cow_src), jnp.int32(dst))
+                self.prefix_cache.cow_copies += 1
+                blocks.append(dst)
+                skipped += m.cow_tokens
+            alloc.free([m.cow_src])  # drop match()'s pin on the source
+        if skipped == 0:
+            self.kv.allocator.free(blocks)
+            return
+        seq.blocks = blocks
+        seq.seen_tokens = skipped
 
     def _flush_table(self) -> None:
         """Re-sync descriptors from the SoA rows before any descriptor-based
@@ -485,7 +602,14 @@ class InferenceEngineV2:
     def _finish(self, seq: SequenceDescriptor) -> None:
         seq.done = True
         self.table.retire(seq)
-        self.kv.release(seq)
+        if self.prefix_cache is not None:
+            # donate full prefix blocks into the radix tree instead of
+            # freeing them (retire() just flushed the SoA row, so
+            # seen_tokens == tokens actually written to KV)
+            self.prefix_cache.donate(seq.tokens, seq.seen_tokens, seq.blocks)
+            seq.blocks = []
+        else:
+            self.kv.release(seq)
         del self.running[seq.uid]
 
     def cancel(self, uid: int) -> bool:

@@ -1,0 +1,245 @@
+"""Cross-request KV prefix cache: radix tree with copy-on-write sharing.
+
+RadixAttention-shaped (SGLang, Zheng et al. 2024) cache over the blocked
+allocator: a tree keyed on ``block_size``-token chunks whose nodes own KV
+block ids.  A finished sequence *donates* its full prefix blocks into the
+tree instead of freeing them; a new request walks the tree and seeds its
+block table with the shared blocks, so prefill starts at the first
+uncached token.  Sharing is pure block-table indirection — the jitted
+ragged forward and the paged-attention kernels never change, and the
+batch stays one XLA program.
+
+Ownership model (see ``BlockedAllocator`` refcounts):
+
+- every tree node holds exactly one reference on its block;
+- ``match`` takes an extra reference per returned block on behalf of the
+  caller (released through the sequence's normal free path);
+- ``donate`` transfers the sequence's reference to the tree when the
+  chunk is new, and drops it when the chunk is already cached (dedupe);
+- ``evict`` removes LRU *leaves* whose block has no owner besides the
+  tree, returning those blocks to the pool.
+
+All mutation happens on the engine thread (the serving broker serializes
+every engine call); gauge reads from other threads only touch ints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .ragged import BlockedAllocator
+
+
+@dataclasses.dataclass(eq=False)
+class _Node:
+    chunk: Tuple[int, ...]  # edge label from parent: block_size token ids
+    block: int  # KV block holding this chunk's keys/values
+    parent: Optional["_Node"]
+    children: Dict[Tuple[int, ...], "_Node"] = dataclasses.field(
+        default_factory=dict)
+    last_used: int = 0
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """Result of a tree walk.  ``blocks`` are full shared blocks covering
+    ``tokens`` prompt tokens; ``cow_src`` (if set) is a block whose first
+    ``cow_tokens`` positions also match and can be copy-on-write forked.
+    Every returned block carries one reference taken for the caller."""
+
+    blocks: List[int]
+    tokens: int
+    cow_src: Optional[int] = None
+    cow_tokens: int = 0
+
+
+class PrefixCache:
+    """Radix tree of cached KV prefixes over a shared block pool.
+
+    ``eviction``: ``"lru"`` frees least-recently-used unreferenced leaves
+    under pool pressure; ``"none"`` never evicts (donated blocks stay
+    pinned until ``reset`` — debugging / bounded workloads only, and such
+    blocks are not counted as reclaimable for admission).
+    """
+
+    def __init__(self, allocator: BlockedAllocator, block_size: int,
+                 min_prefix_tokens: int = 0, eviction: str = "lru"):
+        if eviction not in ("lru", "none"):
+            raise ValueError(f"unknown eviction policy {eviction!r}")
+        self.allocator = allocator
+        self.block_size = block_size
+        self.min_prefix_tokens = min_prefix_tokens
+        self.eviction = eviction
+        self._root = _Node(chunk=(), block=-1, parent=None)
+        self._nodes: List[_Node] = []  # every non-root node
+        self._clock = 0
+        # counters (engine/serving metrics read these as monotonic)
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_skipped = 0
+        self.evictions = 0
+        self.cow_copies = 0
+
+    # -- lookup --------------------------------------------------------
+
+    def match(self, tokens: Sequence[int], limit: int) -> Optional[PrefixMatch]:
+        """Longest cached prefix of ``tokens[:limit]``.
+
+        ``limit`` must leave at least one token to prefill (the scheduler
+        passes ``cur_len - 1``): a fully-cached prompt still needs one
+        forward to produce its first output logit.  Returns ``None`` when
+        nothing (or less than ``min_prefix_tokens``) matches.  Increments
+        ``lookups`` only; the engine counts hits/skipped tokens once the
+        match survives admission.
+        """
+        self.lookups += 1
+        self._clock += 1
+        bs = self.block_size
+        limit = min(limit, len(tokens))
+        node = self._root
+        blocks: List[int] = []
+        matched = 0
+        while matched + bs <= limit:
+            child = node.children.get(tuple(tokens[matched:matched + bs]))
+            if child is None:
+                break
+            node = child
+            node.last_used = self._clock
+            blocks.append(node.block)
+            matched += bs
+        # partial-block divergence: find the child sharing the longest
+        # sub-chunk prefix — its block is the copy-on-write source
+        cow_src: Optional[int] = None
+        cow_tokens = 0
+        room = min(limit - matched, bs)
+        if room > 0:
+            rest = tuple(tokens[matched:matched + room])
+            for chunk, child in node.children.items():
+                m = 0
+                while m < room and chunk[m] == rest[m]:
+                    m += 1
+                # m < bs always: a full-chunk match would have been taken
+                # by the tree walk above
+                if m > cow_tokens:
+                    cow_tokens = m
+                    cow_src = child.block
+                    child.last_used = self._clock
+        total = matched + cow_tokens
+        if total == 0 or total < self.min_prefix_tokens:
+            return None
+        for b in blocks:
+            self.allocator.incref(b)
+        if cow_src is not None:
+            self.allocator.incref(cow_src)
+        return PrefixMatch(blocks=blocks, tokens=matched, cow_src=cow_src,
+                           cow_tokens=cow_tokens)
+
+    # -- insertion -----------------------------------------------------
+
+    def donate(self, tokens: Sequence[int], seen_tokens: int,
+               blocks: List[int]) -> None:
+        """Absorb a finished/cancelled sequence's blocks.
+
+        ``seen_tokens`` is the number of tokens actually written to KV;
+        only full blocks are cacheable.  For each full chunk: if the tree
+        already has it, the sequence's reference is dropped (the shared
+        block was the same one, or a duplicate we don't need); otherwise
+        the node adopts the sequence's reference.  Trailing partial /
+        unused blocks go back to the pool.
+        """
+        self._clock += 1
+        bs = self.block_size
+        n_full = min(seen_tokens // bs, len(blocks))
+        node = self._root
+        for i in range(n_full):
+            chunk = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(chunk)
+            if child is None:
+                child = _Node(chunk=chunk, block=blocks[i], parent=node)
+                node.children[chunk] = child
+                self._nodes.append(child)
+            else:
+                self.allocator.free([blocks[i]])
+            child.last_used = self._clock
+            node = child
+        if blocks[n_full:]:
+            self.allocator.free(blocks[n_full:])
+
+    # -- eviction ------------------------------------------------------
+
+    def evict(self, n: int) -> int:
+        """Free up to ``n`` blocks by removing LRU leaves whose block is
+        referenced only by the tree.  Returns blocks actually freed."""
+        if self.eviction != "lru":
+            return 0
+        freed = 0
+        while freed < n:
+            victim: Optional[_Node] = None
+            for node in self._nodes:
+                if node.children:
+                    continue
+                if self.allocator.refcount(node.block) != 1:
+                    continue  # pinned by a live sequence
+                if victim is None or node.last_used < victim.last_used:
+                    victim = node
+            if victim is None:
+                break
+            del victim.parent.children[victim.chunk]
+            self._nodes.remove(victim)
+            self.allocator.free([victim.block])
+            self.evictions += 1
+            freed += 1
+        return freed
+
+    def reset(self) -> int:
+        """Drop the whole tree, freeing every block no sequence shares.
+        Blocks still referenced by live sequences lose only the tree's
+        reference.  Returns the number of nodes dropped."""
+        dropped = len(self._nodes)
+        for node in self._nodes:
+            self.allocator.free([node.block])
+        self._nodes = []
+        self._root.children = {}
+        return dropped
+
+    # -- accounting ----------------------------------------------------
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def evictable_blocks(self) -> int:
+        """Tree blocks held ONLY by the tree (refcount 1) — reclaimable
+        under pressure when the policy allows eviction."""
+        return sum(1 for nd in self._nodes
+                   if self.allocator.refcount(nd.block) == 1)
+
+    @property
+    def shared_blocks(self) -> int:
+        """Tree blocks also referenced by at least one live sequence."""
+        return sum(1 for nd in self._nodes
+                   if self.allocator.refcount(nd.block) >= 2)
+
+    @property
+    def reclaimable_blocks(self) -> int:
+        """What admission control may count as effectively-free."""
+        return self.evictable_blocks if self.eviction == "lru" else 0
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "hit_rate": self.hit_rate,
+            "prefill_tokens_skipped": self.tokens_skipped,
+            "evictions": self.evictions,
+            "cow_copies": self.cow_copies,
+            "cached_blocks": self.cached_blocks,
+            "shared_blocks": self.shared_blocks,
+            "evictable_blocks": self.evictable_blocks,
+        }

@@ -23,13 +23,22 @@ import numpy as np
 
 
 class BlockedAllocator:
-    """Free-list allocator over a fixed pool of KV blocks
-    (reference: ``blocked_allocator.py:11``)."""
+    """Reference-counted free-list allocator over a fixed pool of KV blocks
+    (reference: ``blocked_allocator.py:11``).
+
+    ``allocate`` hands out blocks with refcount 1; ``free`` decrements and
+    returns a block to the pool only when its last owner releases it —
+    the substrate for cross-request block sharing (prefix cache: one KV
+    block in many block tables).  A ``free`` of a block whose refcount is
+    already 0 raises instead of silently corrupting the pool (the old
+    free list extended unconditionally, so a double-free made the same
+    block allocatable twice)."""
 
     def __init__(self, num_blocks: int):
         if num_blocks <= 0:
             raise ValueError("num_blocks must be positive")
         self._free: List[int] = list(range(num_blocks))
+        self._refs: List[int] = [0] * num_blocks
         self.num_blocks = num_blocks
 
     @property
@@ -42,13 +51,49 @@ class BlockedAllocator:
                 f"KV cache exhausted: requested {n} blocks, {len(self._free)} free")
         out = self._free[:n]
         del self._free[:n]
+        for b in out:
+            self._refs[b] = 1
         return out
+
+    def incref(self, block: int) -> None:
+        """Add an owner to a live (allocated) block — shared-prefix use."""
+        if not (0 <= block < self.num_blocks):
+            raise ValueError(f"invalid block id {block}")
+        if self._refs[block] <= 0:
+            raise ValueError(f"incref on free block {block}")
+        self._refs[block] += 1
+
+    def refcount(self, block: int) -> int:
+        if not (0 <= block < self.num_blocks):
+            raise ValueError(f"invalid block id {block}")
+        return self._refs[block]
 
     def free(self, blocks: List[int]) -> None:
         for b in blocks:
             if not (0 <= b < self.num_blocks):
                 raise ValueError(f"invalid block id {b}")
-        self._free.extend(blocks)
+        for b in blocks:
+            if self._refs[b] <= 0:
+                raise ValueError(
+                    f"double-free of block {b} (refcount already 0)")
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._free.append(b)
+
+    def check_consistency(self) -> None:
+        """Pool invariants: no duplicate free entries, every free block has
+        refcount 0, and free + referenced partitions the pool exactly."""
+        if len(self._free) != len(set(self._free)):
+            raise AssertionError("duplicate block ids in the free list")
+        for b in self._free:
+            if self._refs[b] != 0:
+                raise AssertionError(
+                    f"free block {b} has refcount {self._refs[b]}")
+        live = sum(1 for r in self._refs if r > 0)
+        if live + len(self._free) != self.num_blocks:
+            raise AssertionError(
+                f"pool accounting broken: {live} live + "
+                f"{len(self._free)} free != {self.num_blocks} total")
 
 
 @dataclasses.dataclass
@@ -80,6 +125,9 @@ class KVCacheManager:
         self.allocator = BlockedAllocator(num_blocks)
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
+        # attached by the engine when the prefix cache is enabled; lets
+        # capacity checks reclaim unreferenced cached blocks under pressure
+        self.prefix_cache = None
 
     def blocks_needed(self, seq: SequenceDescriptor, new_tokens: int) -> int:
         total = seq.seen_tokens + new_tokens
@@ -91,6 +139,9 @@ class KVCacheManager:
         need = self.blocks_needed(seq, new_tokens)
         if len(seq.blocks) + need > self.max_blocks_per_seq:
             return False
+        short = need - self.allocator.free_blocks
+        if short > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict(short)
         if need > self.allocator.free_blocks:
             return False
         if need:

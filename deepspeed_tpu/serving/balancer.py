@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Iterator, List, Optional, Sequence
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 from ..monitor.monitor import Monitor
 from ..utils.logging import logger
@@ -241,9 +241,22 @@ class ReplicaPool:
                 "outstanding_tokens": b.outstanding_tokens(),
                 "running": b.engine.num_running,
                 "kv_utilization": round(b.kv_utilization(), 4),
+                "prefix": b.engine.prefix_stats(),
             })
         return {"status": "ok" if self.healthy_replicas() else "down",
                 "accepting": self._accepting, "replicas": reps}
+
+    def _aggregate_prefix_stats(self) -> Dict[str, float]:
+        """Sum engine prefix-cache stats over replicas; hit_rate is
+        recomputed from the pooled counts."""
+        agg: Dict[str, float] = {}
+        for b in self.replicas:
+            for k, v in b.engine.prefix_stats().items():
+                agg[k] = agg.get(k, 0.0) + v
+        agg["enabled"] = float(bool(agg.get("enabled")))
+        lookups = agg.get("lookups", 0.0)
+        agg["hit_rate"] = agg.get("hits", 0.0) / lookups if lookups else 0.0
+        return agg
 
     def _update_gauges(self) -> None:
         running = sum(b.engine.num_running for b in self.replicas)
@@ -251,6 +264,7 @@ class ReplicaPool:
               if b.healthy()]
         self.metrics.set_gauges(self.queue_depth(), running,
                                 sum(kv) / len(kv) if kv else 0.0)
+        self.metrics.set_prefix_stats(self._aggregate_prefix_stats())
 
     def _pump_loop(self) -> None:
         while not self._pump_stop.wait(self.cfg.metrics_interval_s):

@@ -67,9 +67,11 @@ def _one_request(host: str, port: int, prompt: List[int], max_tokens: int,
 
 
 def sweep_point(host: str, port: int, rate_rps: float, duration_s: float,
-                max_tokens: int, prompt_len: int) -> dict:
+                max_tokens: int, prompt_len: int,
+                prompt_fn=None) -> dict:
     """Open-loop offered load: launch requests on a fixed arrival schedule
-    regardless of completions (the honest way to observe backpressure)."""
+    regardless of completions (the honest way to observe backpressure).
+    ``prompt_fn(i)`` overrides prompt construction (prefix-heavy mode)."""
     out = {"completed": 0, "rejected": 0, "failed": 0, "tokens": 0,
            "ttft_s": [], "e2e_s": []}
     lock = threading.Lock()
@@ -81,7 +83,8 @@ def sweep_point(host: str, port: int, rate_rps: float, duration_s: float,
         delay = target - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        prompt = [1 + (7 * i + j) % 250 for j in range(prompt_len)]
+        prompt = prompt_fn(i) if prompt_fn is not None else \
+            [1 + (7 * i + j) % 250 for j in range(prompt_len)]
         th = threading.Thread(target=_one_request,
                               args=(host, port, prompt, max_tokens, out, lock))
         th.start()
@@ -132,21 +135,141 @@ def run_sweep(rates: List[float], duration_s: float = 8.0,
     }
 
 
+# -- prefix-heavy traffic mode ---------------------------------------------
+
+
+def _get_json(host: str, port: int, path: str) -> dict:
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return json.loads(body)
+
+
+def _prefix_health(host: str, port: int) -> dict:
+    """Sum the per-replica prefix stats + load gauges off /healthz."""
+    health = _get_json(host, port, "/healthz")
+    agg = {"running": 0, "queue_depth": 0}
+    for rep in health.get("replicas", []):
+        agg["running"] += rep["running"]
+        agg["queue_depth"] += rep["queue_depth"]
+        for k, v in rep.get("prefix", {}).items():
+            agg[k] = agg.get(k, 0) + v
+    return agg
+
+
+def _await_idle(host: str, port: int, timeout_s: float = 90.0) -> dict:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        h = _prefix_health(host, port)
+        if h["running"] == 0 and h["queue_depth"] == 0:
+            return h
+        time.sleep(0.2)
+    return _prefix_health(host, port)
+
+
+def run_prefix_sweep(rates: List[float], duration_s: float = 6.0,
+                     max_tokens: int = 8, shared_prefix_len: int = 192,
+                     suffix_len: int = 4, tenants: int = 2,
+                     replicas: int = 1, max_queue: int = 32,
+                     repeats: int = 6, env: Optional[dict] = None) -> dict:
+    """Prefix-heavy traffic (tenant templates sharing a long prefix + a
+    short unique suffix) with the cache on vs off.  Records the TTFT
+    sweep per mode, the TTFT of a fully-cached prompt (same prompt
+    repeated sequentially — the cache-on side skips its whole prefill),
+    server-side hit/eviction stats, and the post-drain leak check."""
+    templates = [[1 + (17 * t + 3 * j) % 250
+                  for j in range(shared_prefix_len)] for t in range(tenants)]
+    probe = templates[0] + [251 + t % 2 for t in range(suffix_len)]
+    modes = {}
+    for mode, extra in (("cache_off", []),
+                        ("cache_on", ["--enable_prefix_cache"])):
+        proc, base_url = launch_server_subprocess(
+            ["--model", "tiny", "--port", "0", "--replicas", str(replicas),
+             "--max_queue", str(max_queue), "--max_tokens_per_step", "32",
+             *extra], env=env)
+        host, port = base_url.rsplit("//", 1)[1].rsplit(":", 1)
+        port = int(port)
+        try:
+            # compile warm + (cache_on) populate the radix tree per template
+            warm = {"completed": 0, "rejected": 0, "failed": 0, "tokens": 0,
+                    "ttft_s": [], "e2e_s": []}
+            _one_request(host, port, probe, max_tokens, warm,
+                         threading.Lock())
+            for tpl in templates:
+                _one_request(host, port, tpl + [252] * suffix_len, max_tokens,
+                             warm, threading.Lock())
+            ttfts: List[float] = []
+            for _ in range(repeats):
+                m = {"completed": 0, "rejected": 0, "failed": 0, "tokens": 0,
+                     "ttft_s": [], "e2e_s": []}
+                _one_request(host, port, probe, max_tokens, m,
+                             threading.Lock())
+                ttfts.extend(m["ttft_s"])
+
+            def prompt_fn(i):
+                tpl = templates[i % len(templates)]
+                return tpl + [1 + (13 * i + j) % 250
+                              for j in range(suffix_len)]
+
+            points = [sweep_point(host, port, r, duration_s, max_tokens,
+                                  shared_prefix_len + suffix_len,
+                                  prompt_fn=prompt_fn) for r in rates]
+            idle = _await_idle(host, port)
+        finally:
+            rc = stop_server(proc)
+        modes[mode] = {
+            "fully_cached_ttft_s_p50": round(_percentile(ttfts, 0.50), 4),
+            "fully_cached_ttft_s_mean": round(sum(ttfts) / len(ttfts), 4)
+            if ttfts else 0.0,
+            "sweep": points,
+            "server_prefix_stats_after": {
+                k: round(float(v), 4) for k, v in idle.items()},
+            "leaked_blocks_after_drain": idle.get("pinned_blocks", 0),
+            "graceful_shutdown_rc": rc,
+        }
+    off = modes["cache_off"]["fully_cached_ttft_s_p50"]
+    on = modes["cache_on"]["fully_cached_ttft_s_p50"]
+    return {
+        "subject": "tiny model, JAX_PLATFORMS=cpu, streaming /v1/completions,"
+                   " tenant-template prefix-heavy traffic",
+        "replicas": replicas, "max_queue": max_queue,
+        "max_tokens": max_tokens, "shared_prefix_len": shared_prefix_len,
+        "suffix_len": suffix_len, "tenants": tenants,
+        "duration_s_per_point": duration_s,
+        "fully_cached_ttft_speedup": round(off / on, 2) if on else 0.0,
+        "modes": modes,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
     p = argparse.ArgumentParser(prog="dstpu-serving-bench")
     p.add_argument("--out", default=None,
                    help="merge results into this BENCH_EVIDENCE.json")
+    p.add_argument("--mode", choices=["serving", "prefix"], default="serving")
     p.add_argument("--rates", default="2,8,24")
     p.add_argument("--duration_s", type=float, default=8.0)
-    p.add_argument("--replicas", type=int, default=2)
-    p.add_argument("--max_queue", type=int, default=16)
+    p.add_argument("--replicas", type=int, default=None)
+    p.add_argument("--max_queue", type=int, default=None)
+    p.add_argument("--shared_prefix_len", type=int, default=192)
+    p.add_argument("--tenants", type=int, default=2)
     args = p.parse_args(argv)
 
     rates = [float(r) for r in args.rates.split(",")]
-    result = run_sweep(rates, duration_s=args.duration_s,
-                       replicas=args.replicas, max_queue=args.max_queue)
+    if args.mode == "prefix":
+        result = run_prefix_sweep(
+            rates, duration_s=args.duration_s,
+            shared_prefix_len=args.shared_prefix_len, tenants=args.tenants,
+            replicas=args.replicas or 1, max_queue=args.max_queue or 32)
+        key = "prefix_cache"
+    else:
+        result = run_sweep(rates, duration_s=args.duration_s,
+                           replicas=args.replicas or 2,
+                           max_queue=args.max_queue or 16)
+        key = "serving"
     print(json.dumps(result, indent=2))
     if args.out:
         try:
@@ -154,7 +277,7 @@ def main(argv=None) -> int:
                 evidence = json.load(f)
         except FileNotFoundError:
             evidence = {}
-        evidence["serving"] = result
+        evidence[key] = result
         with open(args.out, "w") as f:
             json.dump(evidence, f, indent=1)
             f.write("\n")

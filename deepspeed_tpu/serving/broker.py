@@ -258,8 +258,12 @@ class RequestBroker:
             return total
 
     def kv_utilization(self) -> float:
+        """Fraction of KV blocks NOT available to new work.  Evictable
+        prefix-cache blocks count as free — a warm cache must not look
+        like pool pressure to deferral / shedding logic."""
         e = self.engine
-        return 1.0 - e.free_blocks / max(e.total_blocks, 1)
+        reclaimable = e.free_blocks + e.reclaimable_blocks
+        return 1.0 - reclaimable / max(e.total_blocks, 1)
 
     def kill(self, reason: str = "replica_dead") -> None:
         """Simulate/execute hard replica death: the engine thread exits and
@@ -418,6 +422,8 @@ class RequestBroker:
                         if self._own_gauges:
                             self.metrics.set_gauges(len(self._queue), 0,
                                                     self.kv_utilization())
+                            self.metrics.set_prefix_stats(
+                                self.engine.prefix_stats())
                         self._wake.wait(self.cfg.idle_wait_s)
                         continue
                 # JAX outside the lock: submit/cancel stay non-blocking
@@ -427,6 +433,7 @@ class RequestBroker:
                     self.metrics.set_gauges(
                         len(self._queue), self.engine.num_running,
                         self.kv_utilization())
+                    self.metrics.set_prefix_stats(self.engine.prefix_stats())
         except Exception as e:  # engine fault → fail outstanding, die
             logger.error(f"serving broker {self.name} engine fault: {e!r}")
             with self._wake:

@@ -64,6 +64,14 @@ class ServingMetrics:
         self.queue_depth = 0
         self.running = 0
         self.kv_utilization = 0.0
+        # prefix-cache mirror (engine-owned counters, summed over replicas
+        # by the pump; all zero when the cache is disabled)
+        self.prefix: Dict[str, float] = {
+            "enabled": 0, "lookups": 0, "hits": 0, "hit_rate": 0.0,
+            "prefill_tokens_skipped": 0, "evictions": 0, "cow_copies": 0,
+            "cached_blocks": 0, "shared_blocks": 0, "evictable_blocks": 0,
+            "pinned_blocks": 0,
+        }
         self._t0 = time.monotonic()
 
     # -- recording hooks (broker/balancer/server) ----------------------
@@ -113,6 +121,15 @@ class ServingMetrics:
             self.running = running
             self.kv_utilization = kv_utilization
 
+    def set_prefix_stats(self, stats: Dict[str, float]) -> None:
+        """Mirror engine prefix-cache stats (see
+        ``InferenceEngineV2.prefix_stats``); pools pass the sum over
+        replicas, with ``hit_rate`` recomputed from the summed counts."""
+        with self._lock:
+            for k in self.prefix:
+                if k in stats:
+                    self.prefix[k] = stats[k]
+
     # -- exposition ----------------------------------------------------
 
     def snapshot(self) -> Dict[str, float]:
@@ -136,6 +153,8 @@ class ServingMetrics:
                               ("queue_wait_ms", self.queue_wait_ms)):
                 for k, v in res.percentiles().items():
                     out[f"{name}_{k}"] = v
+            for k, v in self.prefix.items():
+                out[f"prefix_{k}"] = float(v)
             return out
 
     def to_events(self, step: int) -> List[Event]:

@@ -292,7 +292,10 @@ def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
                   max_seqs=args.max_seqs, block_size=args.block_size,
                   num_blocks=args.num_blocks,
                   max_blocks_per_seq=args.max_blocks_per_seq,
-                  dtype=args.dtype)
+                  dtype=args.dtype,
+                  enable_prefix_cache=args.enable_prefix_cache,
+                  prefix_cache_min_tokens=args.prefix_cache_min_tokens,
+                  prefix_eviction=args.prefix_eviction)
     cfg = ServingConfig(max_queue=args.max_queue,
                         default_max_tokens=args.default_max_tokens,
                         temperature=args.temperature,
@@ -329,6 +332,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--block_size", type=int, default=16)
     p.add_argument("--num_blocks", type=int, default=256)
     p.add_argument("--max_blocks_per_seq", type=int, default=16)
+    p.add_argument("--enable_prefix_cache", action="store_true",
+                   help="cross-request KV prefix cache (radix tree with "
+                        "copy-on-write block sharing)")
+    p.add_argument("--prefix_cache_min_tokens", type=int, default=0,
+                   help="minimum shareable prefix length to take a cache hit")
+    p.add_argument("--prefix_eviction", choices=["lru", "none"],
+                   default="lru")
     p.add_argument("--csv_dir", default=None,
                    help="emit serving metrics to a CSVMonitor at this path")
     args = p.parse_args(argv)

@@ -1,0 +1,461 @@
+"""Cross-request KV prefix cache: refcounted allocator, radix tree,
+copy-on-write forks, LRU eviction, and token-exactness of the cache-enabled
+engine against the uncached forward reference (RadixAttention-style over
+the blocked-allocator substrate — no reference equivalent in
+DeepSpeed-FastGen)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine import InferenceEngineV2, V2Config
+from deepspeed_tpu.inference.v2.prefix_cache import PrefixCache
+from deepspeed_tpu.inference.v2.ragged import BlockedAllocator
+from deepspeed_tpu.models import transformer as tfm
+from deepspeed_tpu.serving import (ReplicaPool, RequestBroker, ServingConfig,
+                                   ServingMetrics)
+
+V2 = dict(max_tokens_per_step=32, max_seqs=4, block_size=8, num_blocks=64,
+          max_blocks_per_seq=8, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tfm.get_config("tiny", dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def ref_fn(tiny_model):
+    """Greedy continuation via the plain uncached forward — the independent
+    reference every cache-enabled path must match token-for-token."""
+    cfg, params = tiny_model
+    cache = {}
+
+    def ref(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in cache:
+            seq = np.array([list(prompt)], np.int32)
+            for _ in range(n):
+                logits = tfm.forward(params, seq, cfg)
+                nxt = np.asarray(logits[:, -1].argmax(-1)).astype(np.int32)
+                seq = np.concatenate([seq, nxt[:, None]], axis=1)
+            cache[key] = seq[0, len(prompt):].tolist()
+        return cache[key]
+
+    return ref
+
+
+def _engine(tiny_model, **over):
+    cfg, params = tiny_model
+    return InferenceEngineV2(
+        cfg, params, V2Config(**{**V2, "enable_prefix_cache": True, **over}))
+
+
+def _assert_no_block_leak(eng, idle=True):
+    """ISSUE leak invariant: free + evictable + pinned == total, with
+    pinned computed from refcounts (orphaned refcounts fail here)."""
+    eng.kv.allocator.check_consistency()
+    free, ev, pin, tot = (eng.free_blocks, eng.evictable_blocks,
+                          eng.pinned_blocks, eng.total_blocks)
+    assert free + ev + pin == tot, (free, ev, pin, tot)
+    if idle:
+        assert pin == 0, f"{pin} blocks pinned with no live sequence"
+
+
+# ---------------------------------------------------------------------------
+# allocator: refcounts + double-free regression
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_double_free_raises():
+    """Satellite regression: the old free list extended unconditionally, so
+    a double-free made the same block allocatable twice."""
+    a = BlockedAllocator(8)
+    got = a.allocate(3)
+    a.free(got[:1])
+    with pytest.raises(ValueError, match="double-free"):
+        a.free(got[:1])
+    with pytest.raises(ValueError, match="double-free"):
+        a.free([got[1], got[1]])  # duplicate ids in one call
+    a.check_consistency()
+    a.free(got[2:])
+    # pool not corrupted: a full drain hands out 8 distinct blocks
+    rest = a.allocate(8)
+    assert len(set(rest)) == 8
+    a.check_consistency()
+
+
+def test_allocator_refcount_sharing():
+    a = BlockedAllocator(4)
+    (b,) = a.allocate(1)
+    a.incref(b)
+    assert a.refcount(b) == 2
+    a.free([b])
+    assert a.free_blocks == 3  # still held by the other owner
+    a.free([b])
+    assert a.free_blocks == 4
+    with pytest.raises(ValueError, match="incref on free"):
+        a.incref(b)
+    with pytest.raises(ValueError, match="double-free"):
+        a.free([b])
+    with pytest.raises(ValueError, match="invalid block"):
+        a.free([99])
+    a.check_consistency()
+
+
+# ---------------------------------------------------------------------------
+# radix tree unit behavior (no model)
+# ---------------------------------------------------------------------------
+
+
+def test_radix_tree_match_donate_evict():
+    a = BlockedAllocator(16)
+    pc = PrefixCache(a, block_size=4)
+    toks = list(range(100, 112))  # 3 full chunks
+    blocks = a.allocate(3)
+    pc.donate(toks, 12, list(blocks))
+    assert pc.cached_blocks == 3 and a.free_blocks == 13
+
+    m = pc.match(toks, limit=11)  # 2 full chunks + 3-token partial
+    assert m.tokens == 8 and m.blocks == blocks[:2]
+    assert m.cow_src == blocks[2] and m.cow_tokens == 3
+    assert a.refcount(blocks[0]) == 2  # match pinned it for the caller
+    a.free(m.blocks)
+    a.free([m.cow_src])
+
+    # donating the same tokens again dedupes: duplicate blocks return
+    dup = a.allocate(3)
+    pc.donate(toks, 12, dup)
+    assert pc.cached_blocks == 3 and a.free_blocks == 13
+
+    # divergent chain shares the common prefix node
+    toks2 = toks[:4] + list(range(200, 208))
+    b2 = a.allocate(3)
+    pc.donate(toks2, 12, list(b2))
+    assert pc.cached_blocks == 5  # root chunk shared, 2 new nodes
+    assert a.free_blocks == 11
+
+    # eviction removes unreferenced LRU leaves only
+    freed = pc.evict(2)
+    assert freed == 2 and pc.evictions == 2
+    assert pc.evict(100) == 3  # drains the rest leaf-by-leaf
+    assert a.free_blocks == 16 and pc.cached_blocks == 0
+    a.check_consistency()
+
+
+def test_radix_tree_pinned_blocks_not_evictable():
+    a = BlockedAllocator(8)
+    pc = PrefixCache(a, block_size=4)
+    blocks = a.allocate(2)
+    pc.donate(list(range(8)), 8, list(blocks))
+    # diverges entirely in chunk 1: pins block 0 only, no COW source
+    m = pc.match(list(range(4)) + [90, 91, 92, 93], limit=7)
+    assert m.blocks == blocks[:1] and m.tokens == 4 and m.cow_src is None
+    assert pc.evict(10) == 1  # only the unpinned leaf goes
+    assert pc.evictable_blocks == 0 and pc.shared_blocks == 1
+    a.free(m.blocks)
+    assert pc.evict(10) == 1  # now reclaimable
+    a.check_consistency()
+
+
+def test_radix_tree_min_prefix_and_none_policy():
+    a = BlockedAllocator(8)
+    pc = PrefixCache(a, block_size=4, min_prefix_tokens=8, eviction="none")
+    pc.donate(list(range(8)), 8, a.allocate(2))
+    assert pc.match(list(range(4)) + [77, 78], limit=5) is None  # 4+1 < 8
+    m = pc.match(list(range(8)) + [9], limit=8)
+    assert m is not None and m.tokens == 8
+    a.free(m.blocks)
+    assert pc.evict(10) == 0  # policy "none" never evicts
+    assert pc.reclaimable_blocks == 0 and pc.evictable_blocks == 2
+    assert pc.reset() == 2
+    assert a.free_blocks == 8
+
+
+# ---------------------------------------------------------------------------
+# engine: token-exactness with sharing, COW, eviction, cancellation
+# ---------------------------------------------------------------------------
+
+
+def test_sequential_reuse_token_exact(devices, tiny_model, ref_fn):
+    """Same prompt served repeatedly: later requests skip prefill via the
+    tree and still produce the exact uncached-reference continuation."""
+    eng = _engine(tiny_model)
+    pA = list(range(1, 21))
+    outs = []
+    for _ in range(3):
+        uid = eng.put(list(pA), max_new_tokens=6)
+        outs.append(eng.generate_all()[uid][len(pA):])
+    ref = ref_fn(pA, 6)
+    assert outs == [ref, ref, ref]
+    s = eng.prefix_stats()
+    assert s["hits"] == 2 and s["prefill_tokens_skipped"] >= 2 * 16
+    _assert_no_block_leak(eng)
+
+
+def test_partial_block_divergence_cow_token_exact(devices, tiny_model,
+                                                  ref_fn):
+    """Prompts diverging mid-block: the second request forks the partially
+    matching block copy-on-write and both outputs stay exact."""
+    eng = _engine(tiny_model)
+    pA = list(range(1, 21))
+    pB = pA[:12] + [99, 98, 97, 96]  # shares block 0 + 4 tokens of block 1
+    uA = eng.put(list(pA), max_new_tokens=6)
+    outA = eng.generate_all()[uA][len(pA):]
+    uB = eng.put(list(pB), max_new_tokens=6)
+    outB = eng.generate_all()[uB][len(pB):]
+    assert outA == ref_fn(pA, 6)
+    assert outB == ref_fn(pB, 6)
+    s = eng.prefix_stats()
+    assert s["cow_copies"] >= 1 and s["hits"] >= 1
+    _assert_no_block_leak(eng)
+
+
+def test_concurrent_sharing_one_block_many_streams(devices, tiny_model,
+                                                   ref_fn):
+    """One cached KV block serves several concurrent sequences: refcount
+    climbs to tree + every sharer, outputs stay exact, and the last
+    release returns nothing early."""
+    eng = _engine(tiny_model)
+    pA = list(range(1, 21))
+    u0 = eng.put(list(pA), max_new_tokens=6)
+    eng.generate_all()  # warm the tree
+    first_block = next(iter(eng.prefix_cache._nodes)).block
+
+    uids = [eng.put(list(pA), max_new_tokens=6) for _ in range(3)]
+    eng.step()  # admission: all three match the cached prefix
+    assert eng.kv.allocator.refcount(first_block) == 4  # tree + 3 sharers
+    assert eng.prefix_stats()["shared_blocks"] >= 2
+    _assert_no_block_leak(eng, idle=False)
+    res = eng.generate_all()
+    ref = ref_fn(pA, 6)
+    for u in uids:
+        assert res[u][len(pA):] == ref
+    assert eng.kv.allocator.refcount(first_block) == 1  # only the tree
+    _assert_no_block_leak(eng)
+
+
+def test_eviction_under_pool_pressure(devices, tiny_model, ref_fn):
+    """Distinct prompts overflow a small pool: LRU eviction reclaims cold
+    tree blocks instead of raising KV-exhausted, outputs stay exact."""
+    eng = _engine(tiny_model, num_blocks=17, max_seqs=2)  # 16 usable
+    for i in range(16):
+        p = [10 * i + j for j in range(1, 13)]  # 12 distinct tokens
+        uid = eng.put(p, max_new_tokens=4)
+        out = eng.generate_all()[uid][len(p):]
+        assert out == ref_fn(p, 4), f"prompt {i}"
+        _assert_no_block_leak(eng)
+    assert eng.prefix_stats()["evictions"] > 0
+
+
+def test_cancel_with_shared_blocks_decrements_refcounts(devices, tiny_model,
+                                                        ref_fn):
+    """Cancelling one of two sharers drops only its references; the
+    survivor and the tree are untouched."""
+    eng = _engine(tiny_model)
+    pA = list(range(1, 21))
+    eng.put(list(pA), max_new_tokens=6)
+    eng.generate_all()
+    first_block = next(iter(eng.prefix_cache._nodes)).block
+
+    keep = eng.put(list(pA), max_new_tokens=6)
+    victim = eng.put(list(pA), max_new_tokens=6)
+    eng.step()
+    assert eng.kv.allocator.refcount(first_block) == 3
+    assert eng.cancel(victim)
+    res = eng.generate_all()
+    assert res[keep][len(pA):] == ref_fn(pA, 6)
+    _assert_no_block_leak(eng)
+
+
+def test_min_prefix_tokens_gates_hits(devices, tiny_model, ref_fn):
+    eng = _engine(tiny_model, prefix_cache_min_tokens=16)
+    pA = list(range(1, 25))  # 3 full blocks cached after donation
+    eng.put(list(pA), max_new_tokens=6)
+    eng.generate_all()
+    # only 8 shared tokens < 16 minimum: no hit, still exact
+    pB = pA[:8] + [88, 87, 86, 85]
+    uB = eng.put(list(pB), max_new_tokens=6)
+    assert eng.generate_all()[uB][len(pB):] == ref_fn(pB, 6)
+    assert eng.prefix_stats()["hits"] == 0
+    # a 23-token match clears the bar
+    uA = eng.put(list(pA), max_new_tokens=6)
+    assert eng.generate_all()[uA][len(pA):] == ref_fn(pA, 6)
+    assert eng.prefix_stats()["hits"] == 1
+    _assert_no_block_leak(eng)
+
+
+def test_burst_decode_with_cache_token_exact(devices, tiny_model, ref_fn):
+    """The multi-token in-graph burst decode path donates correctly too."""
+    eng = _engine(tiny_model)
+    pA = list(range(3, 19))
+    u1 = eng.put(list(pA), max_new_tokens=16)
+    r1 = eng.generate_all(burst=8)[u1][len(pA):]
+    u2 = eng.put(list(pA), max_new_tokens=16)
+    r2 = eng.generate_all(burst=8)[u2][len(pA):]
+    ref = ref_fn(pA, 16)
+    assert r1 == ref and r2 == ref
+    assert eng.prefix_stats()["hits"] == 1
+    _assert_no_block_leak(eng)
+
+
+def test_strict_put_counts_evictable_as_free(devices, tiny_model):
+    """Broker admission must not starve on a warm cache: a pool full of
+    evictable tree blocks still strictly admits."""
+    eng = _engine(tiny_model, num_blocks=17, max_seqs=2)  # 16 usable
+    for i in range(4):  # fill the tree with distinct donated prefixes
+        eng.put([20 * i + j for j in range(1, 13)], max_new_tokens=4)
+        eng.generate_all()
+    assert eng.evictable_blocks > 0
+    assert eng.free_blocks + eng.reclaimable_blocks >= 5
+    # needs 3 blocks; must not raise even if raw free is low
+    eng.put(list(range(240, 252)), max_new_tokens=4, strict=True)
+    eng.generate_all()
+    _assert_no_block_leak(eng)
+
+
+def test_fuzz_shared_templates_cancels_exact_and_leak_free(devices,
+                                                           tiny_model,
+                                                           ref_fn):
+    """Randomized soak: template-heavy traffic with random suffixes and
+    random cancels; allocator invariants hold throughout and every
+    completed request matches the reference."""
+    rng = np.random.RandomState(7)
+    eng = _engine(tiny_model, num_blocks=33)  # 32 usable: real pressure
+    templates = [list(range(1, 17)), list(range(50, 66)), [5, 6, 7, 8]]
+    live, expected = {}, {}
+    for round_ in range(10):
+        # submit 1-2 new requests
+        for _ in range(rng.randint(1, 3)):
+            tpl = templates[rng.randint(len(templates))]
+            suffix = [int(t) for t in rng.randint(100, 250,
+                                                  size=rng.randint(0, 4))]
+            prompt = tpl + suffix
+            n = int(rng.randint(2, 7))
+            uid = eng.put(list(prompt), max_new_tokens=n)
+            live[uid] = (prompt, n)
+        for _ in range(rng.randint(1, 5)):
+            eng.step()
+        if live and rng.rand() < 0.3:  # cancel a random live request
+            victim = list(live)[rng.randint(len(live))]
+            eng.cancel(victim)
+            live.pop(victim)
+        eng.kv.allocator.check_consistency()
+        for uid in [u for u in live
+                    if u not in eng.running
+                    and all(s.uid != u for s in eng.waiting)]:
+            expected[uid] = live.pop(uid)
+    res = eng.generate_all()
+    for uid, (prompt, n) in {**expected, **live}.items():
+        seq_tokens = res.get(uid)
+        if seq_tokens is None or len(seq_tokens) == len(prompt):
+            continue  # cancelled before its first token
+        got = seq_tokens[len(prompt):]
+        assert got == ref_fn(prompt, n)[:len(got)], uid
+    _assert_no_block_leak(eng)
+    assert eng.prefix_stats()["hits"] > 0
+
+
+# ---------------------------------------------------------------------------
+# decode program census: the cache must not change the compiled step
+# ---------------------------------------------------------------------------
+
+
+def test_decode_program_identical_with_cache(devices, tiny_model):
+    """Sharing is host-side block-table indirection: the lowered decode
+    program with the cache on is bit-identical to cache off (the
+    budgets.toml decode_step@v2 gate audits the cache-enabled build)."""
+    cfg, params = tiny_model
+
+    def lowered(cache_on):
+        eng = InferenceEngineV2(
+            cfg, params,
+            V2Config(**{**V2, "enable_prefix_cache": cache_on}))
+        seqs = eng.cfg.max_seqs
+        toks = np.zeros((seqs,), np.int32)
+        pos = np.zeros((seqs,), np.int32)
+        tables = np.zeros((seqs, eng.cfg.max_blocks_per_seq), np.int32)
+        ctx = np.ones((seqs,), np.int32)
+        return eng._decode_fwd.lower(eng.params, eng.caches, toks, pos,
+                                     tables, ctx).as_text()
+
+    assert lowered(True) == lowered(False)
+
+
+# ---------------------------------------------------------------------------
+# serving integration: broker gauges, metrics keys, failover leak asserts
+# ---------------------------------------------------------------------------
+
+
+def _cache_pool(tiny_model, scfg, **over):
+    cfg, params = tiny_model
+    v2 = V2Config(**{**V2, "enable_prefix_cache": True, **over})
+    return ReplicaPool.build(lambda: InferenceEngineV2(cfg, params, v2),
+                             scfg, metrics=ServingMetrics())
+
+
+def test_broker_warm_cache_admission_and_metrics(devices, tiny_model,
+                                                 ref_fn):
+    """A warm cache must not read as pool pressure: kv_utilization counts
+    evictable blocks as free, and the prefix stats surface through
+    snapshot() and the Prometheus exposition."""
+    eng = _engine(tiny_model)
+    broker = RequestBroker(eng, ServingConfig()).start()
+    pA = list(range(1, 21))
+    assert broker.submit(pA, max_new_tokens=6).result(timeout=90) == \
+        ref_fn(pA, 6)
+    assert broker.submit(pA, max_new_tokens=6).result(timeout=90) == \
+        ref_fn(pA, 6)
+    deadline = time.monotonic() + 10
+    while eng.num_running or eng.num_waiting:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    # tree holds blocks, yet utilization reports ~0 (all reclaimable)
+    assert eng.evictable_blocks > 0
+    assert broker.kv_utilization() == pytest.approx(0.0)
+    time.sleep(0.1)  # let the broker loop publish gauges
+    snap = broker.metrics.snapshot()
+    assert snap["prefix_enabled"] == 1
+    assert snap["prefix_hits"] >= 1
+    assert snap["prefix_prefill_tokens_skipped"] > 0
+    assert snap["prefix_pinned_blocks"] == 0
+    text = broker.metrics.to_prometheus()
+    for key in ("dstpu_serving_prefix_hit_rate",
+                "dstpu_serving_prefix_prefill_tokens_skipped",
+                "dstpu_serving_prefix_shared_blocks",
+                "dstpu_serving_prefix_evictable_blocks",
+                "dstpu_serving_prefix_pinned_blocks",
+                "dstpu_serving_prefix_evictions"):
+        assert key in text, key
+    _assert_no_block_leak(eng)
+    broker.stop()
+
+
+def test_pool_failover_with_cache_exact_and_leak_free(devices, tiny_model,
+                                                      ref_fn):
+    """Mid-stream replica kill with the cache enabled: the retried stream
+    is token-exact on the (cold-cache) survivor, and the survivor ends
+    with zero leaked blocks."""
+    pool = _cache_pool(tiny_model, ServingConfig(num_replicas=2)).start()
+    h = pool.submit([1, 2, 3], max_new_tokens=12)
+    it = h.tokens(timeout=90)
+    got = [next(it) for _ in range(3)]
+    pool.kill_replica(h.replica_index)
+    got += list(it)
+    assert got == ref_fn([1, 2, 3], 12)
+    survivors = pool.healthy_replicas()
+    assert len(survivors) == 1
+    b = pool.replicas[survivors[0]]
+    deadline = time.monotonic() + 10
+    while b.engine.num_running or b.engine.num_waiting:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    _assert_no_block_leak(b.engine)
+    agg = pool._aggregate_prefix_stats()
+    assert agg["enabled"] == 1
+    pool.shutdown()

@@ -60,6 +60,19 @@ def _engine(tiny_model, **over):
     return InferenceEngineV2(cfg, params, V2Config(**{**V2, **over}))
 
 
+def _assert_no_block_leak(eng, idle=True):
+    """Allocator leak invariant: every block is free, evictable (prefix
+    tree, refcount 1), or pinned by a live owner — pinned is computed from
+    refcounts, so an orphaned reference fails here even if the free count
+    looks right.  Idle engines must pin nothing."""
+    eng.kv.allocator.check_consistency()
+    free, ev, pin, tot = (eng.free_blocks, eng.evictable_blocks,
+                          eng.pinned_blocks, eng.total_blocks)
+    assert free + ev + pin == tot, (free, ev, pin, tot)
+    if idle:
+        assert pin == 0, f"{pin} blocks pinned with no live sequence"
+
+
 # ---------------------------------------------------------------------------
 # engine hardening: typed admission errors + cancellation
 # ---------------------------------------------------------------------------
@@ -107,6 +120,7 @@ def test_cancel_mid_prefill_and_mid_decode_no_block_leak(devices, tiny_model):
         assert eng.cancel(u2)  # mid-decode
         assert not eng.running and not eng.waiting
         assert eng.kv.allocator.free_blocks == free0, f"leak at cycle {cycle}"
+        _assert_no_block_leak(eng)
     assert not eng.cancel(999)  # unknown uid
 
 
@@ -167,6 +181,7 @@ def test_broker_defers_admission_beyond_engine_capacity(devices, tiny_model,
         assert h.result(timeout=120) == ref_fn([3, 1 + i], 5)
     assert broker.engine.kv.allocator.free_blocks == \
         broker.engine.total_blocks
+    _assert_no_block_leak(broker.engine)
     broker.stop()
 
 
@@ -397,6 +412,7 @@ def test_http_acceptance_concurrent_streams(devices, tiny_model, ref_fn,
         time.sleep(0.05)
     for b in pool.replicas:
         assert b.engine.free_blocks == b.engine.total_blocks
+        _assert_no_block_leak(b.engine)
     assert pool.metrics.snapshot()["cancelled"] >= 2
 
 
@@ -422,6 +438,17 @@ def test_http_replica_kill_mid_stream(devices, tiny_model, ref_fn,
     conn.close()
     assert finish == "length"
     assert toks == ref_fn([6, 5, 4], 12)
+    # the survivors (killed replica's engine is abandoned, not drained)
+    # must end idle with zero leaked blocks
+    survivors = [pool.replicas[i] for i in pool.healthy_replicas()]
+    assert survivors
+    deadline = time.monotonic() + 15
+    while any(b.engine.num_running or b.engine.num_waiting
+              for b in survivors):
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    for b in survivors:
+        _assert_no_block_leak(b.engine)
 
 
 def test_http_429_on_queue_overflow(devices, tiny_model):
@@ -476,7 +503,13 @@ def test_http_healthz_and_metrics(devices, tiny_model, http_stack):
     text = c.getresponse().read().decode()
     for key in ("dstpu_serving_ttft_ms_p50", "dstpu_serving_queue_depth",
                 "dstpu_serving_kv_utilization", "dstpu_serving_goodput_rps",
-                "dstpu_serving_tokens_per_s"):
+                "dstpu_serving_tokens_per_s",
+                # prefix-cache gauges are always exported (enabled=0 when
+                # the deployment runs without the cache)
+                "dstpu_serving_prefix_enabled",
+                "dstpu_serving_prefix_hit_rate",
+                "dstpu_serving_prefix_prefill_tokens_skipped",
+                "dstpu_serving_prefix_evictions"):
         assert key in text, key
     c.request("GET", "/nope")
     assert c.getresponse().status == 404
@@ -515,7 +548,8 @@ def test_metrics_flow_to_monitor_csv(devices, tiny_model, tmp_path):
     csv_dir = tmp_path / "serving"
     names = {p.name for p in csv_dir.glob("*.csv")}
     for expected in ("serving_ttft_ms_p50.csv", "serving_queue_depth.csv",
-                     "serving_kv_utilization.csv", "serving_tokens_out.csv"):
+                     "serving_kv_utilization.csv", "serving_tokens_out.csv",
+                     "serving_prefix_hit_rate.csv"):
         assert expected in names, (expected, names)
     rows = (csv_dir / "serving_ttft_ms_p50.csv").read_text().splitlines()
     assert len(rows) >= 2  # header + at least one sample
