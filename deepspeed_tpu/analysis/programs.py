@@ -10,7 +10,8 @@ collective/aliasing *structure* matches the real thing while a full
 registry compile stays under a minute on a CI box.
 
 Program names are the budget keys: ``train_step@zero{0..3}``,
-``train_step@lora``, ``decode_step@v2``, ``onebit_step``.
+``train_step@lora``, ``decode_step@v2``, ``spec_decode_step@v2``,
+``onebit_step``.
 """
 
 from __future__ import annotations
@@ -200,6 +201,49 @@ def _decode_v2_program() -> ProgramArtifact:
                            meta={"v2": dataclasses.asdict(v2)})
 
 
+def _spec_decode_program() -> ProgramArtifact:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..inference.v2.engine import InferenceEngineV2, V2Config
+    from ..models import transformer as tfm
+
+    cfg = _subject_cfg()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    # the flagship speculative program is the self-draft step: propose (k
+    # Medusa heads) -> verify (ONE multi-position forward) -> accept/reject
+    # all inside one jitted program — the budget proves it compiles with
+    # zero host syncs (no mid-speculation readbacks) and the paged KV
+    # caches still aliased in place
+    v2 = V2Config(max_tokens_per_step=64, max_seqs=4, block_size=8,
+                  num_blocks=64, max_blocks_per_seq=8, dtype="bfloat16",
+                  enable_prefix_cache=True, spec_mode="self_draft", spec_k=4)
+    eng = InferenceEngineV2(cfg, params, v2)
+    seqs = v2.max_seqs
+    tokens = np.zeros((seqs,), np.int32)
+    ctx_lens = np.ones((seqs,), np.int32)
+    tables = np.zeros((seqs, v2.max_blocks_per_seq), np.int32)
+    limit = np.full((seqs,), 32, np.int32)
+    hidden = np.zeros((seqs, cfg.hidden_size), np.float32)
+    compiled = eng._spec_fwd.lower(
+        eng.params, eng.spec_heads, eng.caches, tokens, ctx_lens, tables,
+        limit, hidden, jax.random.PRNGKey(0),
+        jnp.asarray(0.0, jnp.float32)).compile()
+    ctx = AnalysisContext(
+        program="spec_decode_step@v2",
+        compute_dtype="bf16",
+        mesh_devices=1,
+        # the KV caches are donated (donate_argnums=(2,)) — same in-place
+        # contract as plain decode
+        donated_intent_bytes=_tree_bytes(eng.caches),
+        memory_stats=_memory_stats(compiled),
+    )
+    return ProgramArtifact(name="spec_decode_step@v2",
+                           hlo_text=compiled.as_text(), ctx=ctx,
+                           meta={"v2": dataclasses.asdict(v2)})
+
+
 _PROGRAMS: Dict[str, Callable[[], ProgramArtifact]] = {
     "train_step@zero0": _zero_stage_program(0),
     "train_step@zero1": _zero_stage_program(1),
@@ -207,6 +251,7 @@ _PROGRAMS: Dict[str, Callable[[], ProgramArtifact]] = {
     "train_step@zero3": _zero_stage_program(3),
     "train_step@lora": _lora_program,
     "decode_step@v2": _decode_v2_program,
+    "spec_decode_step@v2": _spec_decode_program,
     "onebit_step": _onebit_program,
 }
 

@@ -31,6 +31,20 @@ from .ragged import (DecodeStateTable, KVCacheManager, RaggedBatch,
                      SequenceDescriptor)
 
 
+# Built forward functions are memoized per (builder, configs): every engine
+# over the same shapes — serving replicas, test fixtures — shares ONE jitted
+# callable, so XLA compiles each program once per process instead of once
+# per engine.  Params/caches are call arguments, never closed over, so
+# sharing is safe (donation is per-call).
+_BUILD_CACHE: dict = {}
+
+
+def _memo(key, build):
+    if key not in _BUILD_CACHE:
+        _BUILD_CACHE[key] = build()
+    return _BUILD_CACHE[key]
+
+
 class AdmissionError(ValueError):
     """A request cannot be admitted: the prompt+budget exceeds the maximum
     context, or (``put(strict=True)``) no sequence slot / KV block budget is
@@ -54,6 +68,12 @@ class V2Config:
     enable_prefix_cache: bool = False
     prefix_cache_min_tokens: int = 0  # min shareable prefix to take a hit
     prefix_eviction: str = "lru"  # "lru" | "none"
+    # speculative decoding (inference/v2/spec.py): "draft" proposes with a
+    # small second model, "self_draft" with Medusa-style bolt-on heads
+    # (linear/spec_heads.py); spec_k tokens proposed per step, verified in
+    # one multi-position forward with in-graph accept/reject
+    spec_mode: str = "off"  # "off" | "draft" | "self_draft"
+    spec_k: int = 4
 
 
 # ---------------------------------------------------------------------------
@@ -204,9 +224,13 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
             logits = last_hidden @ params["lm_head"]["w"].astype(dt)
             if "b" in params["lm_head"]:
                 logits = logits + params["lm_head"]["b"].astype(dt)
-        return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+        # last_hidden rides along for the self-draft speculation heads (the
+        # carried state their next proposals are computed from)
+        return (logits.astype(jnp.float32), last_hidden.astype(jnp.float32),
+                {"k": new_k, "v": new_v})
 
-    return jax.jit(fwd, donate_argnums=(1,))
+    return _memo(("ragged_fwd", model_cfg, dataclasses.astuple(v2)),
+                 lambda: jax.jit(fwd, donate_argnums=(1,)))
 
 
 def build_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
@@ -219,7 +243,8 @@ def build_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
         return _decode_body(params, caches, token_ids, position_ids,
                             block_tables, context_lens, model_cfg, v2)
 
-    return jax.jit(fwd, donate_argnums=(1,))
+    return _memo(("decode_fwd", model_cfg, dataclasses.astuple(v2)),
+                 lambda: jax.jit(fwd, donate_argnums=(1,)))
 
 
 def build_multi_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config,
@@ -261,7 +286,9 @@ def build_multi_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config,
             length=num_steps)
         return toks, caches
 
-    return jax.jit(fwd, donate_argnums=(1,))
+    return _memo(("multi_decode", model_cfg, dataclasses.astuple(v2),
+                  num_steps),
+                 lambda: jax.jit(fwd, donate_argnums=(1,)))
 
 
 def build_cow_copy():
@@ -277,7 +304,8 @@ def build_cow_copy():
         return {"k": k.at[:, dst].set(k[:, src]),
                 "v": v.at[:, dst].set(v[:, src])}
 
-    return jax.jit(copy_block, donate_argnums=(0,))
+    return _memo(("cow_copy",),
+                 lambda: jax.jit(copy_block, donate_argnums=(0,)))
 
 
 def _decode_body(params, caches, token_ids, position_ids, block_tables,
@@ -365,7 +393,10 @@ class InferenceEngineV2:
     convenience ``generate_all`` driving requests to completion."""
 
     def __init__(self, model_config: tfm.TransformerConfig, params: Any,
-                 config: Optional[V2Config] = None):
+                 config: Optional[V2Config] = None,
+                 draft_params: Any = None,
+                 draft_config: Optional[tfm.TransformerConfig] = None,
+                 spec_heads: Any = None):
         if (getattr(model_config, "num_experts", 0) > 0 and
                 getattr(model_config, "moe_routing", "capacity") == "expert_choice"):
             raise ValueError(
@@ -415,8 +446,58 @@ class InferenceEngineV2:
             self.cfg.max_blocks_per_seq * self.cfg.block_size)
         self._prefilling = 0  # running seqs still before their first token
         self.fast_steps = 0  # telemetry: SoA decode steps taken
+        self.burst_steps = 0  # telemetry: multi-token burst programs run
         self._uid = 0
         self._rng = jax.random.PRNGKey(0)
+        # -- speculative decoding (inference/v2/spec.py) ---------------
+        mode = self.cfg.spec_mode
+        if mode not in ("off", "draft", "self_draft"):
+            raise ValueError(f"unknown spec_mode {mode!r}")
+        if mode != "off" and self.cfg.spec_k < 1:
+            raise ValueError("spec_k must be >= 1 when speculation is on")
+        self.spec_heads = spec_heads
+        self.draft_params = draft_params
+        self.draft_cfg = None
+        self._draft_caches = None
+        self._draft_fwd = None
+        self._spec_fwd = None
+        # carried final-norm hidden state at each row's last accepted
+        # position — what the self-draft heads propose from
+        self._spec_hidden = np.zeros(
+            (self.cfg.max_seqs, self.model_cfg.hidden_size), np.float32)
+        self.spec_steps = 0
+        self.spec_proposed = 0  # draft tokens offered to verification
+        self.spec_accepted = 0  # draft tokens that made it into the output
+        self.spec_emitted = 0  # total tokens emitted by spec steps
+        self.spec_fallback = 0  # mixed steps taken while speculation enabled
+        if mode == "self_draft":
+            from .spec import build_self_draft_step
+
+            if self.spec_heads is None:
+                from ...linear.spec_heads import init_spec_heads
+
+                # untrained heads still decode correctly (acceptance is just
+                # lower); w2 seeded from the base lm head
+                self.spec_heads = init_spec_heads(
+                    jax.random.PRNGKey(1), self.model_cfg, self.cfg.spec_k,
+                    base_params=self.params)
+            self._spec_fwd = build_self_draft_step(self.model_cfg, self.cfg)
+        elif mode == "draft":
+            from .spec import build_draft_spec_step
+
+            if draft_params is None or draft_config is None:
+                raise ValueError(
+                    "spec_mode='draft' needs draft_params and draft_config")
+            self.draft_cfg = dataclasses.replace(draft_config,
+                                                 dtype=self.cfg.dtype)
+            dshape = (self.draft_cfg.num_layers, self.cfg.num_blocks,
+                      self.cfg.block_size, self.draft_cfg.kv_heads,
+                      self.draft_cfg.head_dim)
+            self._draft_caches = {"k": jnp.zeros(dshape, dt),
+                                  "v": jnp.zeros(dshape, dt)}
+            self._draft_fwd = build_ragged_forward(self.draft_cfg, self.cfg)
+            self._spec_fwd = build_draft_spec_step(
+                self.model_cfg, self.draft_cfg, self.cfg)
 
     # -- capacity accessors (serving metrics / admission control) -------
     @property
@@ -461,6 +542,24 @@ class InferenceEngineV2:
             stats["enabled"] = 1
         stats["pinned_blocks"] = self.pinned_blocks
         return stats
+
+    def spec_stats(self) -> Dict[str, float]:
+        """Speculative-decoding counters for serving metrics; ``enabled=0``
+        and all-zero when ``spec_mode`` is 'off'.  ``acceptance_rate`` is
+        accepted-draft tokens / proposed-draft tokens (bonus/correction
+        tokens excluded from both sides)."""
+        on = self._spec_fwd is not None
+        return {
+            "enabled": float(on),
+            "k": float(self.cfg.spec_k) if on else 0.0,
+            "steps": float(self.spec_steps),
+            "proposed_tokens": float(self.spec_proposed),
+            "accepted_tokens": float(self.spec_accepted),
+            "emitted_tokens": float(self.spec_emitted),
+            "acceptance_rate": (self.spec_accepted / self.spec_proposed
+                                if self.spec_proposed else 0.0),
+            "fallback_steps": float(self.spec_fallback),
+        }
 
     @property
     def num_running(self) -> int:
@@ -535,8 +634,12 @@ class InferenceEngineV2:
         # pool can be exhausted by half-admitted requests and livelock.
         while self.waiting and budget > 0 and len(picks) < self.cfg.max_seqs:
             seq = self.waiting[0]
+            # draft mode can't take prefix hits: skipped prefill would leave
+            # the DRAFT cache without KV for the shared tokens (the tree only
+            # indexes target blocks); self-draft composes fully
             if (self.prefix_cache is not None and not seq.blocks
-                    and seq.seen_tokens == 0):
+                    and seq.seen_tokens == 0
+                    and self.cfg.spec_mode != "draft"):
                 self._match_prefix(seq)
             n = min(seq.cur_len - seq.seen_tokens, budget)
             total_needed = (seq.cur_len - seq.seen_tokens) + seq.max_new_tokens
@@ -602,7 +705,7 @@ class InferenceEngineV2:
     def _finish(self, seq: SequenceDescriptor) -> None:
         seq.done = True
         self.table.retire(seq)
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and self.cfg.spec_mode != "draft":
             # donate full prefix blocks into the radix tree instead of
             # freeing them (retire() just flushed the SoA row, so
             # seen_tokens == tokens actually written to KV)
@@ -657,7 +760,7 @@ class InferenceEngineV2:
         return rows
 
     def _decode_step_fast(self, temperature: float,
-                          rng: Optional[jax.Array]) -> Dict[int, int]:
+                          rng: Optional[jax.Array]) -> Dict[int, List[int]]:
         """Steady-state decode: inputs ARE the table arrays; bookkeeping is
         vectorized; Python touches only sequences that just completed."""
         self.fast_steps += 1
@@ -674,16 +777,77 @@ class InferenceEngineV2:
         sampled = np.asarray(sampled)
         rows = np.nonzero(t.active)[0]
         sel = sampled[rows].astype(np.int32)[None, :]  # (1, ns)
-        out = {t.seq_at[int(r)].uid: int(s) for r, s in zip(rows, sel[0])}
+        out = {t.seq_at[int(r)].uid: [int(s)] for r, s in zip(rows, sel[0])}
         self._advance_rows(sel)
         return out
 
+    def _spec_decode_step(self, temperature: float,
+                          rng: Optional[jax.Array]) -> Dict[int, List[int]]:
+        """Steady-state SPECULATIVE decode: one jitted propose→verify→accept
+        program emits 1..k+1 tokens per sequence.  The host reads back only
+        the emitted tokens + accept lengths; rejected-suffix KV needs no
+        device rollback (stale entries are masked by context_lens and
+        overwritten next step), so prefix-cache refcounts never move."""
+        self.fast_steps += 1
+        self.spec_steps += 1
+        t = self.table
+        if rng is None:
+            self._rng, rng = jax.random.split(self._rng)
+        next_tok, ctx, block_tables, _ = self._table_inputs()
+        limit = jnp.asarray(t.limit)
+        temp = jnp.asarray(temperature, jnp.float32)
+        hidden_np = None
+        if self.cfg.spec_mode == "self_draft":
+            emitted, alen, new_hidden, self.caches = self._spec_fwd(
+                self.params, self.spec_heads, self.caches, next_tok, ctx,
+                block_tables, limit, jnp.asarray(self._spec_hidden), rng,
+                temp)
+            hidden_np = np.asarray(new_hidden)
+        else:
+            emitted, alen, self.caches, self._draft_caches = self._spec_fwd(
+                self.params, self.draft_params, self.caches,
+                self._draft_caches, next_tok, ctx, block_tables, limit, rng,
+                temp)
+        emitted = np.asarray(emitted)  # (max_seqs, k+1)
+        alen = np.asarray(alen)
+        out: Dict[int, List[int]] = {}
+        k = self.cfg.spec_k
+        # per-row Python loop: rows advance by DIFFERENT amounts (accept
+        # length), so the vectorized _advance_rows contract doesn't apply;
+        # the loop body is a handful of scalar ops per ACTIVE row only
+        for r in np.nonzero(t.active)[0]:
+            r = int(r)
+            seq = t.seq_at[r]
+            # never emit past the request budget: the verify forward parks
+            # (and the attention clamp ignores) positions >= t.limit, so
+            # tokens beyond the clamp were never legally produced
+            take = int(min(alen[r] + 1, t.budget[r] - t.gen[r]))
+            toks = emitted[r, :take].astype(np.int32)
+            t.hist[r, t.hist_len[r]:t.hist_len[r] + take] = toks
+            t.hist_len[r] += take
+            t.next_tok[r] = toks[-1]
+            t.ctx[r] += take
+            t.gen[r] += take
+            if hidden_np is not None:
+                self._spec_hidden[r] = hidden_np[r]
+            out[seq.uid] = toks.tolist()
+            self.spec_proposed += k
+            self.spec_accepted += int(min(int(alen[r]), take))
+            self.spec_emitted += take
+            if t.gen[r] >= t.budget[r]:
+                self._finish(seq)
+        return out
+
     def step(self, temperature: float = 0.0, rng: Optional[jax.Array] = None
-             ) -> Dict[int, int]:
-        """One continuous-batching step → {uid: new_token} for sequences that
-        produced a token (prefill-finished or decode)."""
+             ) -> Dict[int, List[int]]:
+        """One continuous-batching step → {uid: new_tokens} for sequences
+        that produced tokens (prefill-finished or decode).  Non-speculative
+        paths emit exactly one token per sequence; speculative steady-state
+        steps emit 1..spec_k+1."""
         if not self.waiting and self.running and self._prefilling == 0:
             # steady state: every running sequence is decoding — SoA path
+            if self._spec_fwd is not None:
+                return self._spec_decode_step(temperature, rng)
             return self._decode_step_fast(temperature, rng)
         self._flush_table()
         picks = self._schedule()
@@ -693,13 +857,22 @@ class InferenceEngineV2:
                     "scheduler made no progress with running sequences — "
                     "KV reservation invariant violated (bug)")
             return {}
+        if self._spec_fwd is not None:
+            self.spec_fallback += 1  # prefill/mixed step: no speculation
         batch = self.builder.build(picks)
-        logits, self.caches = self._fwd(
-            self.params, self.caches,
+        batch_args = (
             jnp.asarray(batch.token_ids), jnp.asarray(batch.position_ids),
             jnp.asarray(batch.seq_index), jnp.asarray(batch.block_tables),
             jnp.asarray(batch.context_lens), jnp.asarray(batch.logits_rows),
             jnp.asarray(batch.chunk_start), jnp.asarray(batch.chunk_len))
+        logits, hidden, self.caches = self._fwd(
+            self.params, self.caches, *batch_args)
+        if self.cfg.spec_mode == "draft":
+            # mirror every target KV write into the draft cache (same block
+            # tables, its own pool array) so the draft scan can decode from
+            # position ctx without ever re-prefilling
+            _, _, self._draft_caches = self._draft_fwd(
+                self.draft_params, self._draft_caches, *batch_args)
         if temperature > 0.0:
             if rng is None:
                 self._rng, rng = jax.random.split(self._rng)
@@ -707,20 +880,27 @@ class InferenceEngineV2:
         else:
             sampled = logits.argmax(-1)
         sampled = np.asarray(sampled)
+        hidden_np = (np.asarray(hidden)
+                     if self.cfg.spec_mode == "self_draft" else None)
 
-        out: Dict[int, int] = {}
+        out: Dict[int, List[int]] = {}
         for row, (seq, n) in enumerate(picks):
             seq.seen_tokens += n
             if seq.seen_tokens >= seq.cur_len:  # produced a next token
                 tok = int(sampled[row])
                 seq.tokens.append(tok)
                 seq.generated += 1
-                out[seq.uid] = tok
+                out[seq.uid] = [tok]
                 if not seq.in_decode:
                     seq.in_decode = True
                     self._prefilling -= 1
                 if seq.generated >= seq.max_new_tokens:
                     self._finish(seq)
+                elif hidden_np is not None:
+                    # hidden at the position whose lm head produced `tok` —
+                    # the state the self-draft heads will propose from
+                    self._spec_hidden[self.table.row_of[seq.uid]] = \
+                        hidden_np[row]
             if seq.uid in self.table.row_of:
                 self.table.sync(seq)
         return out
@@ -756,16 +936,23 @@ class InferenceEngineV2:
             if not self.waiting and not self.running:
                 break
             t = self.table
-            can_burst = (
-                burst > 1
-                and not self.waiting and self.running
-                and self._prefilling == 0
-                and int((t.budget - t.gen)[t.active].min()) >= burst)
-            if can_burst:
-                rng, burst_rng = jax.random.split(rng)
-                self._burst_decode(burst, temperature=temperature,
-                                   rng=burst_rng)
-                continue
+            # spec mode never bursts: the speculative step is already a
+            # multi-token in-graph program with its own budget clamp
+            steady = (burst > 1 and self._spec_fwd is None
+                      and not self.waiting and self.running
+                      and self._prefilling == 0)
+            if steady:
+                # clamp the burst to the smallest remaining budget instead of
+                # disabling bursting outright (the old `min >= burst` gate
+                # silently fell back to 1-token steps for entire batches as
+                # soon as ONE sequence got within `burst` tokens of its cap)
+                eff = min(burst, int((t.budget - t.gen)[t.active].min()))
+                if eff > 1:
+                    rng, burst_rng = jax.random.split(rng)
+                    self._burst_decode(eff, temperature=temperature,
+                                       rng=burst_rng)
+                    self.burst_steps += 1
+                    continue
             rng, step_rng = jax.random.split(rng)
             self.step(temperature=temperature, rng=step_rng)
         self._flush_table()  # max_steps exhaustion: sync still-running seqs

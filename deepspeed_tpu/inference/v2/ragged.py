@@ -190,6 +190,10 @@ class DecodeStateTable:
         self.next_tok = np.zeros(max_seqs, np.int32)  # next input token
         self.gen = np.zeros(max_seqs, np.int32)
         self.budget = np.zeros(max_seqs, np.int32)
+        # lifetime KV reservation end: prompt + max_new_tokens.  Speculative
+        # steps write k tokens past ctx; writes at pos >= limit must park in
+        # the scratch block (the block table has no entry for them).
+        self.limit = np.zeros(max_seqs, np.int32)
         self.active = np.zeros(max_seqs, bool)
         self.hist = np.zeros((max_seqs, max_ctx), np.int32)
         self.hist_len = np.zeros(max_seqs, np.int32)
@@ -206,6 +210,7 @@ class DecodeStateTable:
         bt[:] = 0
         bt[:len(seq.blocks)] = seq.blocks
         self.budget[row] = seq.max_new_tokens
+        self.limit[row] = seq.cur_len + seq.max_new_tokens
         self.hist_len[row] = 0
         self.sync(seq)
         return row
@@ -237,6 +242,7 @@ class DecodeStateTable:
         self.ctx[row] = 0
         self.next_tok[row] = 0
         self.gen[row] = 0
+        self.limit[row] = 0
         self.hist_len[row] = 0
         self._free.append(row)
 

@@ -1,0 +1,303 @@
+"""Speculative decoding for the ragged v2 engine: in-graph draft/verify.
+
+Two propose paths share one verify/accept core (Leviathan et al., "Fast
+Inference from Transformers via Speculative Decoding", 2023):
+
+* **draft-model** — a small model autoregressively proposes ``k`` tokens
+  through its own paged KV cache (same block tables as the target, its own
+  block pool array), then the target verifies all ``k+1`` positions in ONE
+  multi-position ragged forward;
+* **self-draft** — Medusa/EAGLE-style extra decode heads
+  (``linear/spec_heads.py``) applied to the carried last-accepted hidden
+  state propose all ``k`` tokens in one shot, no second model.
+
+The whole propose → verify → accept/correct loop is ONE jitted program per
+step: acceptance is computed with ``lax`` masks (no host sync), both KV
+caches are donated and updated in place, and the host only reads back the
+emitted tokens + accept lengths.  Greedy acceptance keeps the output
+token-identical to non-speculative decode; sampled acceptance implements
+the full accept/residual-resample scheme, which preserves the target
+distribution exactly for any proposal distribution.
+
+Rejected-suffix KV needs **no device-side rollback**: speculative writes
+land at positions ``ctx .. ctx+k`` inside blocks the sequence already owns
+(admission reserves the full budget), stale entries beyond the accepted
+length are masked by ``context_lens`` in every later attention, and the
+next step overwrites them starting at the new ``ctx``.  Rollback is
+host-side bookkeeping only, so prefix-cache block sharing (refcounted
+``BlockedAllocator``) is untouched.  Writes that would run past the
+sequence's lifetime block reservation (``pos_limit = prompt + max_new``)
+are parked in the scratch block — they can never touch another sequence's
+blocks through a zeroed block-table entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ...models import transformer as tfm
+
+
+def _leading_accepts(accept: jax.Array) -> jax.Array:
+    """(S, k) bool accept flags → (S,) length of the leading all-True run."""
+    return jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+
+
+def _take_rows(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """x (S, Q, ...) gathered at per-row position idx (S,) → (S, ...)."""
+    return jnp.take_along_axis(
+        x, idx.reshape((-1,) + (1,) * (x.ndim - 1)), axis=1)[:, 0]
+
+
+def verify_body(params, caches, tokens, ctx, block_tables, pos_limit,
+                model_cfg: tfm.TransformerConfig, v2):
+    """Multi-position decode forward: the target model processes ``Q = k+1``
+    consecutive positions per sequence in one pass over the paged KV cache.
+
+    ``tokens`` (S, Q): position ``ctx+j`` gets ``tokens[:, j]``; row ``s`` is
+    active iff ``ctx[s] > 0``.  Writes at ``pos >= pos_limit`` park in the
+    scratch block (the sequence's reservation ends there — a real write
+    would dereference a zeroed block-table entry).  Attention covers keys
+    ``< min(ctx+Q, pos_limit)``; logits rows at parked positions are
+    garbage the caller must not use (the engine's budget clamp guarantees
+    it never does).
+
+    Returns (logits (S, Q, V) f32, hidden (S, Q, H), caches).
+    """
+    from ...ops.pallas.paged_attention import paged_prefill_attention
+
+    dt = jnp.dtype(v2.dtype)
+    bs = v2.block_size
+    S, Q = tokens.shape
+    pos = ctx[:, None] + jnp.arange(Q)[None, :]  # (S, Q)
+    active = ctx > 0
+    write_ok = active[:, None] & (pos < pos_limit[:, None])
+    scratch_block = caches["k"].shape[1] - 1
+    blk_col = jnp.clip(pos // bs, 0, block_tables.shape[1] - 1)
+    blk_ids = jnp.where(write_ok,
+                        jnp.take_along_axis(block_tables, blk_col, axis=1),
+                        scratch_block)
+    offsets = pos % bs
+    # attention window per row: chunk [ctx, ctx+chunk_len) — clipped at the
+    # reservation so parked (unwritten) key slots are never read
+    chunk_len = jnp.where(active,
+                          jnp.clip(pos_limit - ctx, 0, Q), 0).astype(jnp.int32)
+
+    x = tfm.embed_tokens(params, tokens, model_cfg, position_ids=pos)  # (S,Q,H)
+    cos_full, sin_full = (None, None)
+    if model_cfg.position == "rope":
+        max_len = v2.max_blocks_per_seq * bs
+        cos_full, sin_full = tfm.rope_table(max_len, model_cfg.rot_dim,
+                                            model_cfg.rope_theta)
+    nh, nkv, hd = model_cfg.num_heads, model_cfg.kv_heads, model_cfg.head_dim
+
+    def layer_body(x, inp):
+        lp, k_cache, v_cache = inp
+        a_in = tfm._norm(x, lp["ln1"], model_cfg.norm, model_cfg.norm_eps)
+        q = tfm._lin(a_in, lp["attn"], "wq", "bq").reshape(S, Q, nh, hd)
+        k = tfm._lin(a_in, lp["attn"], "wk", "bk").reshape(S, Q, nkv, hd)
+        v = tfm._lin(a_in, lp["attn"], "wv", "bv").reshape(S, Q, nkv, hd)
+        if model_cfg.position == "rope":
+            cos = cos_full[pos][:, :, None, :].astype(dt)
+            sin = sin_full[pos][:, :, None, :].astype(dt)
+            rd = model_cfg.rot_dim
+
+            def rot(t):
+                tr = t[..., :rd]
+                t1, t2 = tr[..., ::2], tr[..., 1::2]
+                o1 = t1 * cos - t2 * sin
+                o2 = t2 * cos + t1 * sin
+                out = jnp.stack([o1, o2], axis=-1).reshape(tr.shape)
+                if rd == t.shape[-1]:
+                    return out
+                return jnp.concatenate([out, t[..., rd:]], axis=-1)
+
+            q, k = rot(q), rot(k)
+        k_cache = k_cache.at[blk_ids, offsets].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[blk_ids, offsets].set(v.astype(v_cache.dtype))
+        o = paged_prefill_attention(q, k_cache, v_cache, block_tables,
+                                    ctx * active, chunk_len)
+        attn_out = tfm._lin(o.reshape(S, Q, nh * hd), lp["attn"], "wo", "bo")
+        m_src = x if model_cfg.parallel_residual else x + attn_out
+        m_in = tfm._norm(m_src, lp["ln2"], model_cfg.norm, model_cfg.norm_eps)
+        if model_cfg.num_experts > 0:
+            from ...moe.layer import dense_moe_block
+
+            mlp_out = dense_moe_block(m_in, lp["moe"], model_cfg)
+        else:
+            mlp_out = tfm._mlp_block(m_in, lp["mlp"], model_cfg)
+        x = (x + attn_out + mlp_out) if model_cfg.parallel_residual \
+            else (m_src + mlp_out)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_body, x, (params["layers"], caches["k"], caches["v"]))
+    x = tfm._norm(x, params["final_norm"], model_cfg.norm, model_cfg.norm_eps)
+    if model_cfg.tie_embeddings:
+        logits = x @ params["embed"]["tokens"].astype(dt).T
+    else:
+        logits = x @ params["lm_head"]["w"].astype(dt)
+        if "b" in params["lm_head"]:
+            logits = logits + params["lm_head"]["b"].astype(dt)
+    return logits.astype(jnp.float32), x, {"k": new_k, "v": new_v}
+
+
+def _accept_and_emit(logits, draft, draft_probs, rng, temperature):
+    """The accept/correct core shared by both propose paths.
+
+    logits (S, k+1, V) f32 — target logits at positions ctx..ctx+k;
+    draft (S, k) int32 — proposed tokens for positions ctx+1..ctx+k;
+    draft_probs (S, k, V) f32 — the proposal distributions the drafts were
+    actually sampled from (ignored in greedy mode).
+
+    Greedy (temperature == 0): accept the longest prefix where the draft
+    matches the target argmax; the token after it is the target's own
+    argmax — output is token-identical to non-speculative greedy decode.
+
+    Sampled: accept ``d_i`` with prob ``min(1, p_i(d_i)/q_i(d_i))``; on the
+    first rejection sample the correction from ``norm(max(p_i - q_i, 0))``;
+    if all accepted, sample the bonus from ``p_k`` — exactly the target
+    distribution, per the speculative-sampling identity.
+
+    Returns (emitted (S, k+1) int32, accept_len (S,) int32) where
+    ``emitted[:, :a+1]`` = accepted drafts + 1 correction/bonus token.
+    """
+    S, Qk, _ = logits.shape
+    k = Qk - 1
+
+    def greedy(_):
+        g = logits.argmax(-1).astype(jnp.int32)  # (S, k+1)
+        a = _leading_accepts(draft == g[:, :k]) if k else \
+            jnp.zeros((S,), jnp.int32)
+        return a.astype(jnp.int32), _take_rows(g, a)
+
+    def sampled(op_rng):
+        u_rng, fix_rng = jax.random.split(op_rng)
+        p = jax.nn.softmax(logits / jnp.maximum(temperature, 1e-6), axis=-1)
+        if k:
+            q = draft_probs
+            p_d = jnp.take_along_axis(p[:, :k], draft[..., None], -1)[..., 0]
+            q_d = jnp.take_along_axis(q, draft[..., None], -1)[..., 0]
+            u = jax.random.uniform(u_rng, (S, k))
+            a = _leading_accepts(u * q_d < p_d)
+            # correction dist at every position, then select position a:
+            # i < k → norm(max(p_i − q_i, 0)) (fallback p_i if zero mass);
+            # i = k → p_k (bonus)
+            res = jnp.maximum(p[:, :k] - q, 0.0)
+            mass = res.sum(-1, keepdims=True)
+            res = jnp.where(mass > 0, res / jnp.maximum(mass, 1e-20),
+                            p[:, :k])
+            res = jnp.concatenate([res, p[:, k:]], axis=1)  # (S, k+1, V)
+        else:
+            a = jnp.zeros((S,), jnp.int32)
+            res = p
+        fix = jax.random.categorical(
+            fix_rng, jnp.log(_take_rows(res, a) + 1e-20)).astype(jnp.int32)
+        return a.astype(jnp.int32), fix
+
+    a, final = jax.lax.cond(temperature > 0.0, sampled,
+                            lambda _: greedy(None), rng)
+    cols = jnp.arange(k + 1)[None, :]
+    d_pad = jnp.concatenate([draft, jnp.zeros((S, 1), jnp.int32)], axis=1)
+    emitted = jnp.where(cols < a[:, None], d_pad, final[:, None])
+    return emitted.astype(jnp.int32), a
+
+
+def build_self_draft_step(model_cfg: tfm.TransformerConfig, v2):
+    """Self-draft (Medusa-style) speculative step, jitted once.
+
+    ``last_hidden`` (S, H) is the target's final-norm hidden state at the
+    position just before the pending token (the state whose lm-head argmax
+    produced ``next_tok``) — head ``i`` applied to it proposes the token at
+    offset ``i+2``, i.e. drafts for positions ``ctx+1 .. ctx+k``.
+
+    Returns (emitted (S, k+1), accept_len (S,), new_hidden (S, H), caches).
+    """
+    from ...linear.spec_heads import apply_spec_heads
+
+    def spec_step(params, heads, caches, next_tok, ctx, block_tables,
+                  pos_limit, last_hidden, rng, temperature):
+        S = next_tok.shape[0]
+        head_logits = apply_spec_heads(heads, last_hidden)  # (S, k, V) f32
+        d_rng, v_rng = jax.random.split(rng)
+        q = jax.nn.softmax(head_logits / jnp.maximum(temperature, 1e-6), -1)
+        draft = jax.lax.cond(
+            temperature > 0.0,
+            lambda r: jax.random.categorical(r, jnp.log(q + 1e-20), axis=-1
+                                             ).astype(jnp.int32),
+            lambda r: head_logits.argmax(-1).astype(jnp.int32),
+            d_rng)
+        tokens = jnp.concatenate([next_tok[:, None], draft], axis=1)
+        logits, hidden, caches = verify_body(
+            params, caches, tokens, ctx, block_tables, pos_limit,
+            model_cfg, v2)
+        emitted, a = _accept_and_emit(logits, draft, q, v_rng, temperature)
+        new_hidden = _take_rows(hidden, a).astype(jnp.float32)  # (S, H)
+        return emitted, a, new_hidden, caches
+
+    from .engine import _memo
+
+    return _memo(("spec_self_draft", model_cfg, dataclasses.astuple(v2)),
+                 lambda: jax.jit(spec_step, donate_argnums=(2,)))
+
+
+def build_draft_spec_step(model_cfg: tfm.TransformerConfig,
+                          draft_cfg: tfm.TransformerConfig, v2):
+    """Draft-model speculative step, jitted once.
+
+    The draft scan runs ``k+1`` single-token decodes through the DRAFT
+    paged cache (shared block tables, separate pool array): iterations
+    ``0..k-1`` propose ``d_1..d_k``; iteration ``k`` only writes ``d_k``'s
+    draft KV so the draft cache stays complete when all ``k`` drafts are
+    accepted (next step starts at ``ctx+k+1``).  Rejected-suffix draft KV
+    is stale-but-masked, same as the target cache.
+
+    Returns (emitted (S, k+1), accept_len (S,), caches, draft_caches).
+    """
+    from .engine import _decode_body
+
+    k = v2.spec_k
+
+    def spec_step(params, draft_params, caches, draft_caches, next_tok, ctx,
+                  block_tables, pos_limit, rng, temperature):
+        S = next_tok.shape[0]
+        active = ctx > 0
+
+        def draft_iter(carry, i):
+            dcaches, tok, it_rng = carry
+            pos = ctx + i
+            ok = active & (pos < pos_limit)
+            dlogits, dcaches = _decode_body(
+                draft_params, dcaches, tok, pos, block_tables,
+                (pos + 1) * ok, draft_cfg, v2)
+            it_rng, s_rng = jax.random.split(it_rng)
+            qi = jax.nn.softmax(
+                dlogits / jnp.maximum(temperature, 1e-6), axis=-1)
+            nxt = jax.lax.cond(
+                temperature > 0.0,
+                lambda r: jax.random.categorical(
+                    r, jnp.log(qi + 1e-20), axis=-1).astype(jnp.int32),
+                lambda r: dlogits.argmax(-1).astype(jnp.int32),
+                s_rng)
+            return (dcaches, nxt, it_rng), (nxt, qi)
+
+        d_rng, v_rng = jax.random.split(rng)
+        (draft_caches, _, _), (proposals, qs) = jax.lax.scan(
+            draft_iter, (draft_caches, next_tok, d_rng), jnp.arange(k + 1))
+        draft = proposals[:k].T  # (S, k): d_1..d_k (last iter writes KV only)
+        q = jnp.moveaxis(qs[:k], 0, 1)  # (S, k, V)
+        tokens = jnp.concatenate([next_tok[:, None], draft], axis=1)
+        logits, _, caches = verify_body(
+            params, caches, tokens, ctx, block_tables, pos_limit,
+            model_cfg, v2)
+        emitted, a = _accept_and_emit(logits, draft, q, v_rng, temperature)
+        return emitted, a, caches, draft_caches
+
+    from .engine import _memo
+
+    return _memo(("spec_draft", model_cfg, draft_cfg,
+                  dataclasses.astuple(v2)),
+                 lambda: jax.jit(spec_step, donate_argnums=(2, 3)))

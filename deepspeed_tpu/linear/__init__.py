@@ -15,12 +15,15 @@ from .optimized_linear import (ADAPTER_LEAF_KEYS, LoRAWeight, OptimizedLinear,
                                merge_lora_weights, merge_trainable,
                                quantize_base_weight, trainable_mask,
                                trainable_subtree)
+from .spec_heads import (apply_spec_heads, greedy_rollouts, init_spec_heads,
+                         train_spec_heads)
 
 __all__ = [
     "ADAPTER_LEAF_KEYS", "DEFAULT_TARGET_MODULES", "LoRAConfig",
     "LoRAWeight", "OptimizedLinear", "PEFTConfig", "QuantizationConfig",
     "QuantizedBaseWeight", "adapter_only_flat", "apply_lora",
-    "expand_axes_for_lora", "has_lora", "init_lora_weight", "lora_forward",
+    "apply_spec_heads", "expand_axes_for_lora", "greedy_rollouts",
+    "has_lora", "init_lora_weight", "init_spec_heads", "lora_forward",
     "merge_lora_weights", "merge_trainable", "quantize_base_weight",
-    "trainable_mask", "trainable_subtree",
+    "train_spec_heads", "trainable_mask", "trainable_subtree",
 ]

@@ -1,0 +1,147 @@
+"""Self-draft speculation heads: a Medusa/EAGLE-style bolt-on over a
+frozen base model (Cai et al., "Medusa: Simple LLM Inference Acceleration
+Framework with Multiple Decoding Heads", 2024).
+
+Head ``i`` (0-based) is a residual block + output projection applied to the
+base model's final-norm hidden state ``h`` at position ``p``::
+
+    logits_i = (h + silu(h @ w1[i] + b1[i])) @ w2[i]
+
+and predicts the token at position ``p + 2 + i`` — one past the base lm
+head's own next-token prediction, so ``k`` heads propose ``k`` speculative
+tokens from one hidden state with no extra forward pass (the engine carries
+``h`` across steps; see ``inference/v2/spec.py``).
+
+Training is frozen-base PEFT, exactly the ``linear/`` LoRA discipline:
+the head leaves are partitioned out with the same
+:func:`~deepspeed_tpu.linear.optimized_linear.trainable_subtree` /
+:func:`~deepspeed_tpu.linear.optimized_linear.merge_trainable` machinery,
+so ONLY head parameters reach the optimizer state and gradients — the base
+is as frozen as a quantized LoRA base.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models import transformer as tfm
+from .optimized_linear import merge_trainable, trainable_subtree
+
+__all__ = ["init_spec_heads", "apply_spec_heads", "train_spec_heads",
+           "greedy_rollouts"]
+
+
+def init_spec_heads(rng: jax.Array, model_cfg: tfm.TransformerConfig,
+                    k: int, base_params: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, jax.Array]:
+    """Stacked head params ``{"w1": (k,H,H), "b1": (k,H), "w2": (k,H,V)}``.
+
+    ``w1``/``b1`` start near zero (the residual block is ~identity), and
+    ``w2`` copies the base lm head when ``base_params`` is given — untrained
+    heads then propose the base's next-token distribution (a useful warm
+    start: it is exact for self-repeating continuations), and training only
+    has to learn the offset correction.
+    """
+    if k <= 0:
+        raise ValueError(f"spec heads need k >= 1, got {k}")
+    H, V = model_cfg.hidden_size, model_cfg.vocab_size
+    r1, r2 = jax.random.split(rng)
+    w1 = 0.01 * jax.random.normal(r1, (k, H, H), jnp.float32)
+    if base_params is not None:
+        if model_cfg.tie_embeddings:
+            lm = base_params["embed"]["tokens"].astype(jnp.float32).T
+        else:
+            lm = base_params["lm_head"]["w"].astype(jnp.float32)
+        w2 = jnp.broadcast_to(lm[None], (k, H, V)).copy()
+    else:
+        w2 = 0.02 * jax.random.normal(r2, (k, H, V), jnp.float32)
+    return {"w1": w1, "b1": jnp.zeros((k, H), jnp.float32), "w2": w2}
+
+
+def apply_spec_heads(heads: Dict[str, jax.Array], h: jax.Array) -> jax.Array:
+    """h (..., H) → per-head logits (..., k, V), computed in f32."""
+    h = h.astype(jnp.float32)
+    z = jnp.einsum("...h,khj->...kj", h, heads["w1"]) + heads["b1"]
+    hh = h[..., None, :] + jax.nn.silu(z)
+    return jnp.einsum("...kh,khv->...kv", hh, heads["w2"])
+
+
+def greedy_rollouts(params: Dict[str, Any], model_cfg: tfm.TransformerConfig,
+                    prompts: List[List[int]], n_new: int) -> jnp.ndarray:
+    """Greedy continuations from the uncached reference forward — the
+    distillation corpus that matches the engine's own greedy behaviour, so
+    trained heads optimize exactly the acceptance rate the serving path
+    sees.  Returns (len(prompts), prompt_len + n_new) int32 (prompts must
+    share one length)."""
+    import numpy as np
+
+    (plen,) = {len(p) for p in prompts}
+    toks = np.asarray(prompts, np.int32)
+    for _ in range(n_new):
+        logits = tfm.forward(params, jnp.asarray(toks), model_cfg)
+        nxt = np.asarray(logits[:, -1].argmax(-1), np.int32)
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    assert toks.shape == (len(prompts), plen + n_new)
+    return jnp.asarray(toks)
+
+
+def train_spec_heads(base_params: Dict[str, Any],
+                     heads: Dict[str, jax.Array],
+                     model_cfg: tfm.TransformerConfig,
+                     data: jax.Array, *, steps: int = 100, lr: float = 1e-2,
+                     batch_size: int = 8, rng: Optional[jax.Array] = None
+                     ) -> Tuple[Dict[str, jax.Array], List[float]]:
+    """Distill the heads on token sequences ``data`` (N, S) with the base
+    frozen: head ``i``'s logits at position ``p`` get cross-entropy against
+    ``data[:, p + 2 + i]``.
+
+    The base/head partition goes through the PR-2 trainable-mask machinery:
+    frozen leaves become ``None`` in the trainable tree, so they are absent
+    from gradients and the Adam state by construction (asserted in
+    tests/test_spec_decode.py), not by convention.
+    """
+    import optax
+
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    k = int(heads["w1"].shape[0])
+    S = int(data.shape[1])
+    if S < k + 2:
+        raise ValueError(f"need sequences of >= k+2={k + 2} tokens, got {S}")
+    full = {"base": base_params, "heads": heads}
+    mask = {"base": jax.tree.map(lambda _: False, base_params),
+            "heads": jax.tree.map(lambda _: True, heads)}
+    trainable = trainable_subtree(full, mask)
+    opt = optax.adam(lr)
+    opt_state = opt.init(trainable)
+
+    def loss_fn(train_tree, batch):
+        merged = merge_trainable(train_tree, full, mask)
+        h = tfm.forward_hidden(merged["base"], batch, model_cfg)  # (B,S,H)
+        logits = apply_spec_heads(merged["heads"], h)  # (B,S,k,V)
+        total = 0.0
+        count = 0
+        for i in range(k):
+            lp = jax.nn.log_softmax(logits[:, : S - 2 - i, i], axis=-1)
+            tgt = batch[:, 2 + i:]
+            ce = -jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
+            total = total + ce.sum()
+            count += ce.size
+        return total / count
+
+    def head_train_step(train_tree, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(train_tree, batch)
+        updates, opt_state = opt.update(grads, opt_state, train_tree)
+        return optax.apply_updates(train_tree, updates), opt_state, loss
+
+    step = jax.jit(head_train_step, donate_argnums=(0, 1))
+    losses: List[float] = []
+    n = int(data.shape[0])
+    for s in range(steps):
+        rng, b_rng = jax.random.split(rng)
+        idx = jax.random.randint(b_rng, (min(batch_size, n),), 0, n)
+        trainable, opt_state, loss = step(trainable, opt_state, data[idx])
+        losses.append(float(loss))
+    return merge_trainable(trainable, full, mask)["heads"], losses

@@ -242,6 +242,7 @@ class ReplicaPool:
                 "running": b.engine.num_running,
                 "kv_utilization": round(b.kv_utilization(), 4),
                 "prefix": b.engine.prefix_stats(),
+                "spec": b.engine.spec_stats(),
             })
         return {"status": "ok" if self.healthy_replicas() else "down",
                 "accepting": self._accepting, "replicas": reps}
@@ -258,6 +259,22 @@ class ReplicaPool:
         agg["hit_rate"] = agg.get("hits", 0.0) / lookups if lookups else 0.0
         return agg
 
+    def _aggregate_spec_stats(self) -> Dict[str, float]:
+        """Sum engine speculative-decoding stats over replicas;
+        acceptance_rate is recomputed from the pooled token counts and ``k``
+        is reported once (replicas share one config), not summed."""
+        agg: Dict[str, float] = {}
+        for b in self.replicas:
+            for k, v in b.engine.spec_stats().items():
+                agg[k] = agg.get(k, 0.0) + v
+        agg["enabled"] = float(bool(agg.get("enabled")))
+        if self.replicas:
+            agg["k"] = self.replicas[0].engine.spec_stats()["k"]
+        proposed = agg.get("proposed_tokens", 0.0)
+        agg["acceptance_rate"] = (agg.get("accepted_tokens", 0.0) / proposed
+                                  if proposed else 0.0)
+        return agg
+
     def _update_gauges(self) -> None:
         running = sum(b.engine.num_running for b in self.replicas)
         kv = [b.kv_utilization() for i, b in enumerate(self.replicas)
@@ -265,6 +282,7 @@ class ReplicaPool:
         self.metrics.set_gauges(self.queue_depth(), running,
                                 sum(kv) / len(kv) if kv else 0.0)
         self.metrics.set_prefix_stats(self._aggregate_prefix_stats())
+        self.metrics.set_spec_stats(self._aggregate_spec_stats())
 
     def _pump_loop(self) -> None:
         while not self._pump_stop.wait(self.cfg.metrics_interval_s):

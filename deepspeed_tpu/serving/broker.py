@@ -375,29 +375,34 @@ class RequestBroker:
                         if r.state in _TERMINAL]:
                 del self._by_rid[rid]
 
-    def _dispatch(self, out: Dict[int, int], now: float) -> None:
-        for uid, tok in out.items():
+    def _dispatch(self, out: Dict[int, List[int]], now: float) -> None:
+        # engine steps deliver token LISTS: one entry normally, up to
+        # spec_k+1 from a speculative step.  A stop token mid-list cancels
+        # the request and drops the speculative suffix after it.
+        for uid, toks in out.items():
             with self._lock:
                 req = self._by_uid.get(uid)
             if req is None:
                 continue
-            if tok in req.stop_ids:
-                with self._wake:
-                    self.engine.cancel(uid)
-                    self._finalize_locked(req, "stop")
-                continue
-            req.delivered += 1
-            if req.first_token_ts is None:
-                req.first_token_ts = now
-                req.state = RequestState.DECODE
-                self.metrics.record_first_token(now - req.submit_ts)
+            for tok in toks:
+                if tok in req.stop_ids:
+                    with self._wake:
+                        self.engine.cancel(uid)
+                        self._finalize_locked(req, "stop")
+                    break
+                req.delivered += 1
+                if req.first_token_ts is None:
+                    req.first_token_ts = now
+                    req.state = RequestState.DECODE
+                    self.metrics.record_first_token(now - req.submit_ts)
+                else:
+                    self.metrics.record_token(now - req.last_token_ts)
+                req.last_token_ts = now
+                req.out_q.put(("tok", tok))
             else:
-                self.metrics.record_token(now - req.last_token_ts)
-            req.last_token_ts = now
-            req.out_q.put(("tok", tok))
-            if uid not in self.engine.running:  # budget exhausted this step
-                with self._wake:
-                    self._finalize_locked(req, "length")
+                if uid not in self.engine.running:  # budget exhausted
+                    with self._wake:
+                        self._finalize_locked(req, "length")
 
     def _run(self) -> None:
         try:
@@ -424,6 +429,8 @@ class RequestBroker:
                                                     self.kv_utilization())
                             self.metrics.set_prefix_stats(
                                 self.engine.prefix_stats())
+                            self.metrics.set_spec_stats(
+                                self.engine.spec_stats())
                         self._wake.wait(self.cfg.idle_wait_s)
                         continue
                 # JAX outside the lock: submit/cancel stay non-blocking
@@ -434,6 +441,7 @@ class RequestBroker:
                         len(self._queue), self.engine.num_running,
                         self.kv_utilization())
                     self.metrics.set_prefix_stats(self.engine.prefix_stats())
+                    self.metrics.set_spec_stats(self.engine.spec_stats())
         except Exception as e:  # engine fault → fail outstanding, die
             logger.error(f"serving broker {self.name} engine fault: {e!r}")
             with self._wake:

@@ -72,6 +72,13 @@ class ServingMetrics:
             "cached_blocks": 0, "shared_blocks": 0, "evictable_blocks": 0,
             "pinned_blocks": 0,
         }
+        # speculative-decoding mirror (engine-owned counters, summed over
+        # replicas by the pump; all zero when spec_mode is "off")
+        self.spec: Dict[str, float] = {
+            "enabled": 0, "k": 0, "steps": 0, "proposed_tokens": 0,
+            "accepted_tokens": 0, "emitted_tokens": 0,
+            "acceptance_rate": 0.0, "fallback_steps": 0,
+        }
         self._t0 = time.monotonic()
 
     # -- recording hooks (broker/balancer/server) ----------------------
@@ -130,6 +137,15 @@ class ServingMetrics:
                 if k in stats:
                     self.prefix[k] = stats[k]
 
+    def set_spec_stats(self, stats: Dict[str, float]) -> None:
+        """Mirror engine speculative-decoding stats (see
+        ``InferenceEngineV2.spec_stats``); pools pass the sum over replicas,
+        with ``acceptance_rate`` recomputed from the summed counts."""
+        with self._lock:
+            for k in self.spec:
+                if k in stats:
+                    self.spec[k] = stats[k]
+
     # -- exposition ----------------------------------------------------
 
     def snapshot(self) -> Dict[str, float]:
@@ -155,6 +171,8 @@ class ServingMetrics:
                     out[f"{name}_{k}"] = v
             for k, v in self.prefix.items():
                 out[f"prefix_{k}"] = float(v)
+            for k, v in self.spec.items():
+                out[f"spec_{k}"] = float(v)
             return out
 
     def to_events(self, step: int) -> List[Event]:

@@ -295,7 +295,32 @@ def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
                   dtype=args.dtype,
                   enable_prefix_cache=args.enable_prefix_cache,
                   prefix_cache_min_tokens=args.prefix_cache_min_tokens,
-                  prefix_eviction=args.prefix_eviction)
+                  prefix_eviction=args.prefix_eviction,
+                  spec_mode=args.spec_mode, spec_k=args.spec_k)
+    draft_params, draft_cfg, spec_heads = None, None, None
+    if args.spec_mode == "draft":
+        draft_cfg = tfm.get_config(args.spec_draft_model or args.model,
+                                   dtype=args.dtype)
+        draft_seed = (args.spec_draft_seed if args.spec_draft_seed is not None
+                      else args.seed)
+        draft_params = tfm.init_params(jax.random.PRNGKey(draft_seed),
+                                       draft_cfg)
+    elif args.spec_mode == "self_draft" and args.spec_train_steps > 0:
+        # distill the speculation heads on the base model's own greedy
+        # rollouts before serving starts (frozen-base PEFT — only head
+        # params ever reach the optimizer); replicas share the result
+        import numpy as np
+
+        from ..linear.spec_heads import (greedy_rollouts, init_spec_heads,
+                                         train_spec_heads)
+
+        spec_heads = init_spec_heads(jax.random.PRNGKey(1), model_cfg,
+                                     args.spec_k, base_params=params)
+        rs = np.random.RandomState(args.seed)
+        prompts = rs.randint(1, model_cfg.vocab_size, size=(32, 4)).tolist()
+        data = greedy_rollouts(params, model_cfg, prompts, args.spec_k + 10)
+        spec_heads, _ = train_spec_heads(params, spec_heads, model_cfg, data,
+                                         steps=args.spec_train_steps)
     cfg = ServingConfig(max_queue=args.max_queue,
                         default_max_tokens=args.default_max_tokens,
                         temperature=args.temperature,
@@ -307,8 +332,12 @@ def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
 
         monitor = CSVMonitor(args.csv_dir, job_name="serving")
     metrics = ServingMetrics()
-    pool = ReplicaPool.build(lambda: InferenceEngineV2(model_cfg, params, v2),
-                             cfg, metrics=metrics, monitor=monitor)
+    pool = ReplicaPool.build(
+        lambda: InferenceEngineV2(model_cfg, params, v2,
+                                  draft_params=draft_params,
+                                  draft_config=draft_cfg,
+                                  spec_heads=spec_heads),
+        cfg, metrics=metrics, monitor=monitor)
     return pool, metrics, cfg
 
 
@@ -339,6 +368,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="minimum shareable prefix length to take a cache hit")
     p.add_argument("--prefix_eviction", choices=["lru", "none"],
                    default="lru")
+    p.add_argument("--spec_mode", choices=["off", "draft", "self_draft"],
+                   default="off",
+                   help="speculative decoding: 'draft' proposes with a small "
+                        "second model, 'self_draft' with Medusa-style heads "
+                        "over the frozen base")
+    p.add_argument("--spec_k", type=int, default=4,
+                   help="speculative tokens proposed (and verified in one "
+                        "forward) per decode step")
+    p.add_argument("--spec_draft_model", default=None,
+                   help="model preset for the draft model (draft mode); "
+                        "defaults to --model")
+    p.add_argument("--spec_draft_seed", type=int, default=None,
+                   help="init seed for the draft model; defaults to --seed "
+                        "(same preset + same seed → draft == target, the "
+                        "acceptance-rate upper bound)")
+    p.add_argument("--spec_train_steps", type=int, default=0,
+                   help="self_draft: distill the speculation heads for this "
+                        "many steps on the base model's greedy rollouts "
+                        "before serving starts (0 = lm-head-seeded init)")
     p.add_argument("--csv_dir", default=None,
                    help="emit serving metrics to a CSVMonitor at this path")
     args = p.parse_args(argv)

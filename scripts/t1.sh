@@ -74,6 +74,18 @@ then
     exit 2
 fi
 
+# speculative-decoding suite: imports the in-graph draft/verify step
+# (inference/v2/spec.py), the self-draft heads (linear/spec_heads.py), and
+# the broker's multi-token dispatch path
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_spec_decode.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_spec_decode.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi

@@ -1,0 +1,425 @@
+"""Speculative decoding tests (inference/v2/spec.py, linear/spec_heads.py).
+
+The load-bearing property: GREEDY speculative output is token-identical to
+the uncached non-speculative forward in every scheduling shape — sequential,
+concurrent, mid-stream cancellation, prefix-cache sharing — because greedy
+acceptance compares drafts against the target argmax, so draft quality can
+only change SPEED, never output.  Sampled mode is held to the Leviathan
+accept/residual-resample identity (the emitted marginal IS the target
+distribution).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine import InferenceEngineV2, V2Config
+from deepspeed_tpu.linear.spec_heads import (apply_spec_heads,
+                                             greedy_rollouts,
+                                             init_spec_heads,
+                                             train_spec_heads)
+from deepspeed_tpu.models import transformer as tfm
+from deepspeed_tpu.serving import (RequestBroker, ServingConfig,
+                                   ServingMetrics)
+
+V2 = dict(max_tokens_per_step=32, max_seqs=4, block_size=8, num_blocks=64,
+          max_blocks_per_seq=8, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    # fp32: exact-match assertions must not be bf16 argmax-tie noise
+    cfg = tfm.get_config("tiny", dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def ref_fn(tiny_model):
+    """Greedy continuation via the plain uncached forward — the independent
+    reference every speculative path must match token-for-token."""
+    cfg, params = tiny_model
+    cache = {}
+    L = 64  # fixed shape bucket: causal attention makes trailing padding
+    # invisible to earlier positions, so every ref call reuses ONE compiled
+    # forward instead of compiling a program per sequence length
+
+    def ref(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in cache:
+            assert len(prompt) + n <= L
+            seq = np.zeros((1, L), np.int32)
+            seq[0, :len(prompt)] = prompt
+            cur = len(prompt)
+            for _ in range(n):
+                logits = tfm.forward(params, seq, cfg)
+                seq[0, cur] = int(np.asarray(logits[0, cur - 1]).argmax())
+                cur += 1
+            cache[key] = seq[0, len(prompt):cur].tolist()
+        return cache[key]
+
+    return ref
+
+
+def _engine(tiny_model, mode, **over):
+    cfg, params = tiny_model
+    kw = {}
+    if mode == "draft":
+        # draft == target: the acceptance upper bound, and the strongest
+        # identity test (any off-by-one in draft KV positions breaks it)
+        kw = dict(draft_params=params, draft_config=cfg)
+    return InferenceEngineV2(
+        cfg, params, V2Config(**{**V2, "spec_mode": mode, **over}), **kw)
+
+
+def _assert_no_block_leak(eng, idle=True):
+    eng.kv.allocator.check_consistency()
+    free, ev, pin, tot = (eng.free_blocks, eng.evictable_blocks,
+                          eng.pinned_blocks, eng.total_blocks)
+    assert free + ev + pin == tot, (free, ev, pin, tot)
+    if idle:
+        assert pin == 0, f"{pin} blocks pinned with no live sequence"
+
+
+MODES = ["self_draft", "draft"]
+
+
+# ---------------------------------------------------------------------------
+# greedy identity: the output must be EXACTLY the non-speculative tokens
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_greedy_identity_sequential(devices, tiny_model, ref_fn, mode):
+    eng = _engine(tiny_model, mode, spec_k=3)
+    for prompt, n in [([5, 6, 7, 8], 9), ([1, 2, 3], 6), ([42], 11)]:
+        uid = eng.put(prompt, max_new_tokens=n)
+        res = eng.generate_all()
+        assert res[uid] == prompt + ref_fn(prompt, n), (mode, prompt)
+    assert eng.spec_steps > 0
+    _assert_no_block_leak(eng)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_greedy_identity_concurrent_streams(devices, tiny_model, ref_fn,
+                                            mode):
+    """Interleaved requests with different lengths/budgets share the batch;
+    each stream must still be token-exact, and rows must never cross."""
+    eng = _engine(tiny_model, mode, spec_k=4)
+    reqs = [([5, 6, 7], 8), ([9, 8, 7, 6], 5), ([11, 12], 12), ([3], 7)]
+    uids = [eng.put(p, max_new_tokens=n) for p, n in reqs]
+    res = eng.generate_all()
+    for uid, (p, n) in zip(uids, reqs):
+        assert res[uid] == p + ref_fn(p, n), (mode, p)
+    # draft == target accepts (nearly) everything: speculation must have
+    # actually emitted multi-token steps, not silently fallen back
+    if mode == "draft":
+        assert eng.spec_emitted > eng.spec_steps
+    _assert_no_block_leak(eng)
+
+
+def test_step_emits_token_lists(devices, tiny_model, ref_fn):
+    """The step() contract: {uid: [tokens...]} with 1..k+1 tokens per entry;
+    concatenation over steps is the exact greedy continuation."""
+    k = 3
+    eng = _engine(tiny_model, "draft", spec_k=k)
+    prompt, n = [7, 8, 9], 10
+    uid = eng.put(prompt, max_new_tokens=n)
+    got = []
+    for _ in range(50):
+        if not eng.running and not eng.waiting:
+            break
+        out = eng.step()
+        for toks in out.values():
+            assert isinstance(toks, list) and 1 <= len(toks) <= k + 1
+        got.extend(out.get(uid, []))
+    assert got == ref_fn(prompt, n)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_cancel_mid_speculation(devices, tiny_model, ref_fn, mode):
+    """Cancel between speculative steps: survivors stay token-exact and
+    every block of the victim returns to the pool."""
+    eng = _engine(tiny_model, mode, spec_k=4)
+    free0 = eng.kv.allocator.free_blocks
+    keep = eng.put([5, 6, 7], max_new_tokens=12)
+    victim = eng.put([1, 2, 3, 4], max_new_tokens=12)
+    eng.step()  # prefill both
+    eng.step()  # at least one speculative step with both rows live
+    assert eng.cancel(victim)
+    res = eng.generate_all()
+    assert res[keep] == [5, 6, 7] + ref_fn([5, 6, 7], 12)
+    assert eng.kv.allocator.free_blocks == free0
+    _assert_no_block_leak(eng)
+
+
+def test_arrival_mid_decode_falls_back_then_resumes(devices, tiny_model,
+                                                    ref_fn):
+    """A new arrival forces mixed prefill steps mid-stream; the engine must
+    fall back (counted) and still produce exact tokens for both."""
+    eng = _engine(tiny_model, "self_draft", spec_k=3)
+    u1 = eng.put([5, 6, 7], max_new_tokens=14)
+    eng.step()  # prefill u1
+    eng.step()  # speculative step
+    u2 = eng.put([9, 8, 7], max_new_tokens=6)  # arrival mid-speculation
+    res = eng.generate_all()
+    assert res[u1] == [5, 6, 7] + ref_fn([5, 6, 7], 14)
+    assert res[u2] == [9, 8, 7] + ref_fn([9, 8, 7], 6)
+    assert eng.spec_fallback > 0
+    _assert_no_block_leak(eng)
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: rejected-suffix rollback must be invisible to refcounts
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_spec_rollback_keeps_refcounts(devices, tiny_model,
+                                                    ref_fn):
+    cfg, params = tiny_model
+    eng = InferenceEngineV2(cfg, params, V2Config(
+        **{**V2, "spec_mode": "self_draft", "spec_k": 3,
+           "enable_prefix_cache": True}))
+    shared = list(range(1, 17))  # two full blocks of shareable prefix
+    u1 = eng.put(shared + [20], max_new_tokens=6)
+    r1 = eng.generate_all()
+    assert r1[u1] == shared + [20] + ref_fn(shared + [20], 6)
+    # second request takes the prefix hit and decodes speculatively THROUGH
+    # the shared blocks' attention window
+    u2 = eng.put(shared + [21], max_new_tokens=8)
+    got = []
+    while eng.waiting or eng._prefilling:
+        got.extend(eng.step().get(u2, []))
+    assert eng.prefix_cache.hits >= 1
+    alloc = eng.kv.allocator
+    refs0 = [alloc.refcount(b) for b in range(alloc.num_blocks)]
+    spec0 = eng.spec_steps
+    while u2 in eng.running:
+        got.extend(eng.step().get(u2, []))
+        if u2 in eng.running:  # _finish legitimately moves refcounts
+            refs = [alloc.refcount(b) for b in range(alloc.num_blocks)]
+            assert refs == refs0, \
+                "speculative rollback moved a block refcount"
+    assert eng.spec_steps > spec0
+    assert got == ref_fn(shared + [21], 8)
+    _assert_no_block_leak(eng, idle=False)
+
+
+def test_prefix_cache_spec_token_identity_warm(devices, tiny_model, ref_fn):
+    """Warm-cache speculative decode is token-exact (the shared-prefix KV
+    the verify forward attends through came from a donated tree)."""
+    cfg, params = tiny_model
+    eng = InferenceEngineV2(cfg, params, V2Config(
+        **{**V2, "spec_mode": "self_draft", "spec_k": 4,
+           "enable_prefix_cache": True}))
+    shared = [1 + (3 * j) % 250 for j in range(20)]
+    for suffix in ([31], [32], [33]):
+        uid = eng.put(shared + suffix, max_new_tokens=7)
+        res = eng.generate_all()
+        assert res[uid] == shared + suffix + ref_fn(shared + suffix, 7)
+    assert eng.prefix_cache.hits >= 2
+    _assert_no_block_leak(eng, idle=False)  # cached blocks remain, pinned 0
+    assert eng.pinned_blocks == 0
+
+
+# ---------------------------------------------------------------------------
+# sampled mode: the speculative-sampling identity
+# ---------------------------------------------------------------------------
+
+
+def test_sampled_acceptance_preserves_target_distribution(devices):
+    """Accept/residual-resample must emit the FIRST token with exactly the
+    target marginal p_0, for an arbitrary (mismatched) proposal q — the
+    Leviathan identity.  Checked against a same-size exact-sampling
+    baseline so the tolerance is calibrated, not hand-waved."""
+    from deepspeed_tpu.inference.v2.spec import _accept_and_emit
+
+    k, V, N = 2, 8, 4000
+    r1, r2, r3, r4 = jax.random.split(jax.random.PRNGKey(42), 4)
+    logits = 1.5 * jax.random.normal(r1, (1, k + 1, V))
+    q = jax.nn.softmax(1.5 * jax.random.normal(r2, (1, k, V)), axis=-1)
+
+    def one(key):
+        dk, ak = jax.random.split(key)
+        draft = jax.random.categorical(
+            dk, jnp.log(q + 1e-20), axis=-1).astype(jnp.int32)
+        emitted, _ = _accept_and_emit(logits, draft, q, ak,
+                                      jnp.asarray(1.0, jnp.float32))
+        return emitted[0, 0]
+
+    toks = np.asarray(jax.jit(jax.vmap(one))(jax.random.split(r3, N)))
+    p = np.asarray(jax.nn.softmax(logits[0, 0]))
+    tv_spec = 0.5 * np.abs(np.bincount(toks, minlength=V)[:V] / N - p).sum()
+    base = np.asarray(jax.random.categorical(
+        r4, jnp.broadcast_to(jnp.log(p), (N, V))))
+    tv_base = 0.5 * np.abs(np.bincount(base, minlength=V)[:V] / N - p).sum()
+    assert tv_spec < max(3.0 * tv_base, 0.05), (tv_spec, tv_base)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_sampled_spec_completes_with_sane_stats(devices, tiny_model, mode):
+    eng = _engine(tiny_model, mode, spec_k=3)
+    uids = [eng.put([1 + i, 2, 3], max_new_tokens=9) for i in range(3)]
+    res = eng.generate_all(temperature=0.7, seed=11)
+    for uid in uids:
+        assert len(res[uid]) == 3 + 9
+    s = eng.spec_stats()
+    assert s["enabled"] == 1 and s["steps"] > 0
+    # proposals are counted per ACTIVE ROW (k drafts each); every spec step
+    # has at least one active row and at most max_seqs of them
+    assert s["steps"] * 3 <= s["proposed_tokens"] <= s["steps"] * 4 * 3
+    assert s["proposed_tokens"] % 3 == 0
+    assert 0 <= s["accepted_tokens"] <= s["proposed_tokens"]
+    assert s["emitted_tokens"] >= s["steps"]
+    _assert_no_block_leak(eng)
+
+
+# ---------------------------------------------------------------------------
+# satellite: burst budget clamp
+# ---------------------------------------------------------------------------
+
+
+def test_burst_clamps_to_remaining_budget(devices, tiny_model, ref_fn):
+    """A request whose budget is smaller than the burst length must still
+    take (clamped) multi-token bursts — the old gate disabled bursting for
+    the whole batch — and stay token-exact."""
+    cfg, params = tiny_model
+    eng = InferenceEngineV2(cfg, params, V2Config(**V2))
+    uid = eng.put([5, 6, 7], max_new_tokens=5)  # budget 5 < burst 8
+    res = eng.generate_all(burst=8)
+    assert res[uid] == [5, 6, 7] + ref_fn([5, 6, 7], 5)
+    assert eng.burst_steps >= 1, "burst gate still disables partial bursts"
+
+
+def test_burst_clamp_mixed_budgets_token_exact(devices, tiny_model, ref_fn):
+    cfg, params = tiny_model
+    eng = InferenceEngineV2(cfg, params, V2Config(**V2))
+    u1 = eng.put([5, 6, 7], max_new_tokens=21)
+    u2 = eng.put([9, 8], max_new_tokens=6)
+    res = eng.generate_all(burst=8)
+    assert res[u1] == [5, 6, 7] + ref_fn([5, 6, 7], 21)
+    assert res[u2] == [9, 8] + ref_fn([9, 8], 6)
+    assert eng.burst_steps >= 1
+    _assert_no_block_leak(eng)
+
+
+# ---------------------------------------------------------------------------
+# self-draft heads: frozen-base training through the PR-2 mask machinery
+# ---------------------------------------------------------------------------
+
+
+def test_spec_head_training_updates_heads_only(devices, tiny_model):
+    cfg, params = tiny_model
+    heads = init_spec_heads(jax.random.PRNGKey(3), cfg, k=2,
+                            base_params=params)
+    prompts = [[1 + i, 5, 9] for i in range(8)]
+    data = greedy_rollouts(params, cfg, prompts, n_new=8)
+    assert data.shape == (8, 3 + 8)
+    base_snap = [np.asarray(x).copy() for x in jax.tree.leaves(params)]
+    # the train step donates the head buffers: snapshot before training
+    head_snap = {k0: np.asarray(heads[k0]).copy()
+                 for k0 in ("w1", "b1", "w2")}
+    trained, losses = train_spec_heads(params, heads, cfg, data, steps=25,
+                                       lr=5e-3, batch_size=4)
+    assert len(losses) == 25 and losses[-1] < losses[0]
+    # the base must be bit-identical after training (frozen by construction:
+    # its leaves are None in the trainable tree, absent from the optimizer)
+    for snap, cur in zip(base_snap, jax.tree.leaves(params)):
+        np.testing.assert_array_equal(snap, np.asarray(cur))
+    assert any(
+        not np.array_equal(np.asarray(trained[k0]), head_snap[k0])
+        for k0 in ("w1", "b1", "w2"))
+
+
+def test_trainable_subtree_excludes_base(devices, tiny_model):
+    """Only head leaves reach gradients/optimizer: frozen leaves are None
+    and thus absent from the flattened trainable tree."""
+    from deepspeed_tpu.linear import trainable_subtree
+
+    cfg, params = tiny_model
+    heads = init_spec_heads(jax.random.PRNGKey(3), cfg, k=2)
+    full = {"base": params, "heads": heads}
+    mask = {"base": jax.tree.map(lambda _: False, params),
+            "heads": jax.tree.map(lambda _: True, heads)}
+    leaves = jax.tree.leaves(trainable_subtree(full, mask))
+    assert len(leaves) == 3  # w1, b1, w2 — nothing from the base
+
+
+def test_spec_head_shapes_and_seeding(devices, tiny_model):
+    cfg, params = tiny_model
+    heads = init_spec_heads(jax.random.PRNGKey(1), cfg, k=3,
+                            base_params=params)
+    H, V = cfg.hidden_size, cfg.vocab_size
+    assert heads["w1"].shape == (3, H, H)
+    assert heads["b1"].shape == (3, H)
+    assert heads["w2"].shape == (3, H, V)
+    # w2 seeded from the (tied) lm head: untrained heads propose the base's
+    # next-token distribution
+    lm = np.asarray(params["embed"]["tokens"], np.float32).T
+    np.testing.assert_allclose(np.asarray(heads["w2"][0]), lm, rtol=1e-6)
+    logits = apply_spec_heads(heads, jnp.ones((2, H)))
+    assert logits.shape == (2, 3, V)
+    with pytest.raises(ValueError):
+        init_spec_heads(jax.random.PRNGKey(0), cfg, k=0)
+
+
+# ---------------------------------------------------------------------------
+# config validation + serving surface
+# ---------------------------------------------------------------------------
+
+
+def test_spec_config_validation(devices, tiny_model):
+    cfg, params = tiny_model
+    with pytest.raises(ValueError, match="spec_mode"):
+        InferenceEngineV2(cfg, params, V2Config(**{**V2,
+                                                   "spec_mode": "banana"}))
+    with pytest.raises(ValueError, match="draft_params"):
+        InferenceEngineV2(cfg, params, V2Config(**{**V2,
+                                                   "spec_mode": "draft"}))
+    with pytest.raises(ValueError, match="spec_k"):
+        InferenceEngineV2(cfg, params, V2Config(
+            **{**V2, "spec_mode": "self_draft", "spec_k": 0}))
+
+
+def test_spec_stats_surface_in_metrics(devices, tiny_model, ref_fn):
+    eng = _engine(tiny_model, "self_draft", spec_k=3)
+    uid = eng.put([5, 6, 7], max_new_tokens=8)
+    res = eng.generate_all()
+    assert res[uid] == [5, 6, 7] + ref_fn([5, 6, 7], 8)
+    m = ServingMetrics()
+    m.set_spec_stats(eng.spec_stats())
+    snap = m.snapshot()
+    assert snap["spec_enabled"] == 1.0
+    assert snap["spec_steps"] > 0
+    assert snap["spec_proposed_tokens"] == eng.spec_stats()["proposed_tokens"]
+    prom = m.to_prometheus()
+    for gauge in ("dstpu_serving_spec_proposed_tokens",
+                  "dstpu_serving_spec_accepted_tokens",
+                  "dstpu_serving_spec_acceptance_rate",
+                  "dstpu_serving_spec_fallback_steps"):
+        assert gauge in prom, gauge
+
+
+def test_broker_dispatches_spec_token_lists(devices, tiny_model, ref_fn):
+    """The broker must deliver multi-token speculative steps in order and
+    honour a stop token that lands MID-list (speculative suffix dropped)."""
+    cfg, params = tiny_model
+    expect = ref_fn([5, 6, 7], 12)
+    broker = RequestBroker(_engine(tiny_model, "draft", spec_k=3),
+                           ServingConfig()).start()
+    try:
+        h = broker.submit([5, 6, 7], max_new_tokens=12)
+        assert h.result(timeout=120) == expect
+        # stop at the 3rd generated token: everything after it (including
+        # any speculative tokens from the same step) must be dropped
+        stop = expect[2]
+        cut = expect.index(stop)
+        h2 = broker.submit([5, 6, 7], max_new_tokens=12,
+                           stop_token_ids=(stop,))
+        assert h2.result(timeout=120) == expect[:cut]
+        assert h2.finish_reason == "stop"
+        assert broker.engine.spec_steps > 0
+    finally:
+        broker.stop()
+    _assert_no_block_leak(broker.engine)
