@@ -10,8 +10,10 @@ traced path records static op counts and byte volumes; eager timing lives in
 from __future__ import annotations
 
 import collections
+import time
 from typing import Dict, List, Optional, Sequence
 
+from ..observability.trace import tracer
 from ..utils.logging import log_dist
 
 
@@ -76,6 +78,10 @@ class CommsLogger:
         rec.count += 1
         rec.total_bytes += nbytes
         rec.total_time_s += seconds
+        # retroactive span: the op just finished, `seconds` ago → now
+        now = time.monotonic()
+        tracer.add_span(f"comm/{op}", now - seconds, now,
+                        attrs={"bytes": nbytes})
 
     def reset(self) -> None:
         self.stats.clear()

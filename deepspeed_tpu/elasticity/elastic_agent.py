@@ -27,6 +27,8 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..launcher.runner import DEFAULT_COORDINATOR_PORT
+from ..observability.recorder import recorder
+from ..observability.trace import tracer
 from ..utils.logging import logger
 from ..utils.proc import terminate_procs
 from .elasticity import ElasticityConfig, compute_elastic_config
@@ -198,6 +200,12 @@ class ElasticAgent:
         self.current_members = list(members)
         logger.info(f"elastic agent: started {n} workers "
                     f"(restart {self.restart_count}, port {port}): {members}")
+        tracer.add_event("elastic/start_group",
+                         attrs={"workers": n, "restart": self.restart_count,
+                                "members": list(members)})
+        recorder.record_event("elastic/start_group", workers=n,
+                              restart=self.restart_count,
+                              members=list(members))
 
     def _stop_group(self) -> None:
         terminate_procs(self.procs, term_timeout_s=self.cfg.term_timeout_s)
@@ -255,6 +263,15 @@ class ElasticAgent:
                 reason = ("worker failure" if any_failed
                           else f"membership change → {new_members}")
                 logger.warning(f"elastic agent: re-rendezvous ({reason})")
+                tracer.add_event("elastic/re_rendezvous",
+                                 attrs={"reason": reason,
+                                        "restart": self.restart_count})
+                recorder.record_event("elastic/re_rendezvous", reason=reason,
+                                      restart=self.restart_count,
+                                      rcs=[rc for rc in rcs if rc is not None])
+                if any_failed:
+                    # leave a postmortem of what the agent saw at the kill
+                    recorder.dump(reason="worker_failure")
                 self._stop_group()
                 if self.restart_count >= self.cfg.max_restarts:
                     logger.error("elastic agent: max_restarts exhausted")

@@ -17,6 +17,7 @@ prefill steps.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from functools import partial
 from typing import Any, Deque, Dict, List, Optional, Tuple
@@ -26,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models import transformer as tfm
+from ...observability.recorder import recorder
+from ...observability.trace import tracer
 from .ragged import (DecodeStateTable, KVCacheManager, RaggedBatch,
                      RaggedBatchBuilder,
                      SequenceDescriptor)
@@ -843,7 +846,41 @@ class InferenceEngineV2:
         """One continuous-batching step → {uid: new_tokens} for sequences
         that produced tokens (prefill-finished or decode).  Non-speculative
         paths emit exactly one token per sequence; speculative steady-state
-        steps emit 1..spec_k+1."""
+        steps emit 1..spec_k+1.
+
+        Instrumentation is host-side only (a span + flight-recorder append
+        around the untouched step body), so tracing provably changes no
+        compiled program."""
+        steady = (not self.waiting and self.running
+                  and self._prefilling == 0)
+        kind = (("spec" if self._spec_fwd is not None else "decode")
+                if steady else "mixed")
+        running, waiting = self.num_running, len(self.waiting)
+        prop0, acc0 = self.spec_proposed, self.spec_accepted
+        t0 = time.monotonic()
+        sp = tracer.begin("engine/step", kind=kind, running=running,
+                          waiting=waiting, prefilling=self._prefilling)
+        try:
+            out = self._step_impl(temperature=temperature, rng=rng)
+        except Exception:
+            tracer.end(sp, error=True)
+            raise
+        emitted = sum(len(v) for v in out.values())
+        attrs = {"emitted": emitted}
+        if kind == "spec":
+            attrs["proposed"] = self.spec_proposed - prop0
+            attrs["accepted"] = self.spec_accepted - acc0
+        tracer.end(sp, **attrs)
+        recorder.record_step({
+            "kind": kind, "t_start": t0, "t_end": time.monotonic(),
+            "running": running, "waiting": waiting,
+            "prefilling": self._prefilling, "emitted": emitted, **(
+                {"proposed": attrs["proposed"], "accepted": attrs["accepted"]}
+                if kind == "spec" else {})})
+        return out
+
+    def _step_impl(self, temperature: float = 0.0,
+                   rng: Optional[jax.Array] = None) -> Dict[int, List[int]]:
         if not self.waiting and self.running and self._prefilling == 0:
             # steady state: every running sequence is decoding — SoA path
             if self._spec_fwd is not None:

@@ -22,6 +22,10 @@ class Monitor:
     def write_events(self, events: List[Event]) -> None:
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release sink resources (file handles, writers, sessions).
+        Idempotent; called from engine/server/broker shutdown paths."""
+
 
 class CSVMonitor(Monitor):
     """Reference: ``monitor/csv_monitor.py``."""
@@ -50,6 +54,14 @@ class CSVMonitor(Monitor):
         for f, _ in self._files.values():
             f.flush()
 
+    def close(self) -> None:
+        for f, _ in self._files.values():
+            try:
+                f.close()
+            except Exception:  # pragma: no cover
+                pass
+        self._files.clear()
+
 
 class TensorBoardMonitor(Monitor):
     def __init__(self, output_path: str, job_name: str = "job"):
@@ -69,6 +81,11 @@ class TensorBoardMonitor(Monitor):
             self.writer.add_scalar(name, value, step)
         self.writer.flush()
 
+    def close(self) -> None:
+        if self.enabled:
+            self.writer.close()
+            self.enabled = False
+
 
 class WandbMonitor(Monitor):  # pragma: no cover - needs network
     def __init__(self, team=None, group=None, project=None, job_name="job"):
@@ -87,6 +104,11 @@ class WandbMonitor(Monitor):  # pragma: no cover - needs network
             return
         for name, value, step in events:
             self.wandb.log({name: value}, step=step)
+
+    def close(self) -> None:
+        if self.enabled:
+            self.wandb.finish()
+            self.enabled = False
 
 
 class CometMonitor(Monitor):  # pragma: no cover - needs network
@@ -110,6 +132,11 @@ class CometMonitor(Monitor):  # pragma: no cover - needs network
             return
         for name, value, step in events:
             self.experiment.log_metric(name, value, step=step)
+
+    def close(self) -> None:
+        if self.enabled:
+            self.experiment.end()
+            self.enabled = False
 
 
 class MonitorMaster(Monitor):
@@ -142,3 +169,11 @@ class MonitorMaster(Monitor):
         for m in self.monitors:
             if m.enabled:
                 m.write_events(events)
+
+    def close(self) -> None:
+        for m in self.monitors:
+            try:
+                m.close()
+            except Exception as e:  # pragma: no cover
+                logger.warning(f"monitor close failed: {e}")
+        self.enabled = False

@@ -1,0 +1,37 @@
+"""deepspeed_tpu.observability — end-to-end request tracing, flight
+recorder, and first-class Prometheus exposition.
+
+Exceeds the reference DeepSpeed, which ships a monitor fan-out
+(``deepspeed/monitor``) and a comms logger but nothing request-scoped:
+
+* :mod:`.trace` — always-on span tracer (thread-safe ring buffer, host-side
+  only, Chrome/Perfetto export) threaded through the whole request
+  lifecycle: broker submit→queue→admit→prefill→decode/spec→finish, engine
+  steps with batch-composition attrs, checkpoint save/load, elastic-agent
+  relaunches, comm-collective timings;
+* :mod:`.recorder` — flight recorder: bounded rings of the last N request
+  timelines / M engine steps / K infra events, dumped to
+  ``$DSTPU_FLIGHT_DIR`` on crash or injected fault;
+* :mod:`.prometheus` — text-exposition builder (HELP/TYPE, histograms,
+  labels) plus a strict format parser used as the test oracle.
+
+Server surfaces (``serving/server.py``): ``GET /debug/requests`` (recent
+timelines), ``GET /debug/trace`` (Perfetto JSON), ``GET /debug/profile``
+(on-demand ``jax.profiler`` capture).  CLI:
+``python -m deepspeed_tpu.observability <flight-dump.json>``.
+
+Tracing never enters a jitted computation, so the analysis budgets
+(zero host syncs, HLO identity) hold with tracing on — enforced by
+``tests/test_observability.py`` token-identity and the tier-1 budget gate.
+"""
+
+from .prometheus import (DEFAULT_MS_BUCKETS, ExpositionBuilder,
+                         ExpositionError, Histogram, parse_exposition)
+from .recorder import FlightRecorder, load_dump, recorder
+from .trace import Span, Tracer, add_event, add_span, span, tracer
+
+__all__ = [
+    "DEFAULT_MS_BUCKETS", "ExpositionBuilder", "ExpositionError",
+    "FlightRecorder", "Histogram", "Span", "Tracer", "add_event", "add_span",
+    "load_dump", "parse_exposition", "recorder", "span", "tracer",
+]

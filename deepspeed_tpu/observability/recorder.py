@@ -1,0 +1,142 @@
+"""Flight recorder: bounded postmortem rings dumped to disk on crash/fault.
+
+Three rings, all host-side and cheap to append to:
+
+* **requests** — the last N completed request timelines (rid, phase spans,
+  finish reason, TTFT) assembled by the serving broker at finalize;
+* **steps** — the last M engine steps (kind, batch composition, duration)
+  recorded by ``InferenceEngineV2.step``;
+* **events** — the last K infrastructure events (replica kills, elastic
+  relaunches, checkpoint commits, injected faults).
+
+On a crash the rings answer "what was this replica doing?":
+
+* the fault-injection harness (``utils/faults.py``) runs registered crash
+  hooks before ``os._exit`` — :func:`install_crash_hook` registers a dump;
+* the serving broker dumps on an engine fault before failing its streams;
+* the elastic agent dumps its own recorder when a worker dies.
+
+Dumps land in ``$DSTPU_FLIGHT_DIR`` (no dump when unset and no explicit
+path is given — crashing processes must not scatter files into arbitrary
+working directories).  ``python -m deepspeed_tpu.observability <dump>``
+renders a dump as a human-readable timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+from ..utils.logging import logger
+
+_ENV_DIR = "DSTPU_FLIGHT_DIR"
+
+
+class FlightRecorder:
+    """Bounded in-memory postmortem state (module singleton ``recorder``)."""
+
+    def __init__(self, max_requests: int = 256, max_steps: int = 512,
+                 max_events: int = 256):
+        self._lock = threading.Lock()
+        self._requests: Deque[Dict[str, Any]] = deque(maxlen=max_requests)
+        self._steps: Deque[Dict[str, Any]] = deque(maxlen=max_steps)
+        self._events: Deque[Dict[str, Any]] = deque(maxlen=max_events)
+        self._hook_installed = False
+
+    # -- recording -------------------------------------------------------
+
+    def record_request(self, timeline: Dict[str, Any]) -> None:
+        """Append one finished request's timeline (see the broker's
+        ``_timeline_locked`` for the shape: rid, replica, spans, ttft_ms,
+        finish_reason, tokens_out)."""
+        with self._lock:
+            self._requests.append(timeline)
+
+    def record_step(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self._steps.append(record)
+
+    def record_event(self, name: str, **attrs: Any) -> None:
+        with self._lock:
+            self._events.append({"name": name, "t": time.monotonic(),
+                                 "wall": time.time(), **attrs})
+
+    # -- reading / dumping ----------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"requests": list(self._requests),
+                    "steps": list(self._steps),
+                    "events": list(self._events)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._requests.clear()
+            self._steps.clear()
+            self._events.clear()
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "manual") -> Optional[str]:
+        """Write the rings as JSON; returns the path, or None when no
+        destination is configured.  Must never raise — it runs on crash
+        paths where a secondary failure would mask the primary one."""
+        try:
+            if path is None:
+                d = os.environ.get(_ENV_DIR)
+                if not d:
+                    return None
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(
+                    d, f"flight_{os.getpid()}_{reason}_{int(time.time())}.json")
+            body = self.snapshot()
+            body["meta"] = {
+                "pid": os.getpid(), "reason": reason,
+                "wall": time.time(), "mono": time.monotonic(),
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(body, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            logger.error(f"flight recorder: dumped {len(body['requests'])} "
+                         f"request timelines / {len(body['steps'])} steps to "
+                         f"{path} (reason: {reason})")
+            return path
+        except Exception as e:  # noqa: BLE001 — crash path; never mask
+            try:
+                logger.error(f"flight recorder dump failed: {e!r}")
+            except Exception:
+                pass
+            return None
+
+    # -- crash wiring ----------------------------------------------------
+
+    def install_crash_hook(self) -> None:
+        """Register a dump with the fault injector's pre-``os._exit`` hooks
+        (idempotent).  An injected hard-kill then leaves a postmortem on
+        disk — the in-process stand-in for 'the replica died and we want to
+        know what it was doing'."""
+        if self._hook_installed:
+            return
+        from ..utils import faults
+
+        faults.add_crash_hook(self._crash_dump)
+        self._hook_installed = True
+
+    def _crash_dump(self, site: str) -> None:
+        self.dump(reason=f"fault_{site.replace('.', '_')}")
+
+
+#: process-wide recorder every subsystem records into
+recorder = FlightRecorder()
+
+
+def load_dump(path: str) -> Dict[str, Any]:
+    """Read a dump back (CLI / tests)."""
+    with open(path) as f:
+        return json.load(f)

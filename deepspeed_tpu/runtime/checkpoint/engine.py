@@ -52,6 +52,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...observability.recorder import recorder
+from ...observability.trace import tracer
 from ...utils import faults
 from ...utils.logging import log_dist, logger
 
@@ -416,7 +418,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         faults.maybe_truncate("ckpt.truncate.optimizer", opt_path)
 
     def _do_save():
-        with _SAVE_LOCK:
+        # span inside the (possibly async) runner so it measures real IO
+        # time, not just the submit
+        with _SAVE_LOCK, tracer.span("ckpt/save", tag=tag, dir=ckpt_dir,
+                                     engine=cfg.engine,
+                                     async_save=cfg.async_save):
             # leftovers from crashed saves; our own stale staging dir too
             # (a previous kill between mkdir and commit under the same tag)
             _gc_stale_tmp(save_dir, current=None)
@@ -434,6 +440,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             faults.maybe_fail("ckpt.latest")
             _write_latest(save_dir, tag)
             log_dist(f"saved checkpoint {ckpt_dir}")
+            recorder.record_event("ckpt/commit", tag=tag, dir=ckpt_dir)
             _prune_old(save_dir, cfg.keep_n_latest, latest_tag=tag)
 
     # only process 0 writes; EVERY process reaches the barrier below (a
@@ -640,7 +647,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             failures.append(msg)
             continue
         try:
-            result = _load_native(engine, ckpt_dir, load_optimizer_states)
+            with tracer.span("ckpt/load", tag=t, dir=ckpt_dir):
+                result = _load_native(engine, ckpt_dir, load_optimizer_states)
         except _RECOVERABLE_LOAD_ERRORS as e:
             # damage the manifest could not see (e.g. a torn write that
             # landed before the manifest digests were computed from disk)

@@ -26,7 +26,9 @@ import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 from ..monitor.monitor import Monitor
-from ..utils.logging import logger
+from ..observability.recorder import recorder
+from ..observability.trace import tracer
+from ..utils.logging import logger, request_logger
 from .broker import (BrokerStoppedError, QueueFullError, RequestBroker,
                      RequestFailedError, RequestHandle)
 from .config import ServingConfig
@@ -92,9 +94,18 @@ class BalancedHandle:
                     raise
                 attempts += 1
                 time.sleep(self._pool.cfg.retry_backoff_s * attempts)
-                logger.warning(
-                    f"serving: retrying {self._handle.rid} after "
-                    f"{e.reason} (attempt {attempts})")
+                request_logger(self._handle.rid).warning(
+                    f"serving: retrying after {e.reason} "
+                    f"(attempt {attempts})")
+                tracer.add_event("request/failover",
+                                 trace_id=self._handle.rid,
+                                 attrs={"reason": e.reason,
+                                        "attempt": attempts,
+                                        "from_replica": self.replica_index})
+                recorder.record_event("request/failover",
+                                      rid=self._handle.rid, reason=e.reason,
+                                      attempt=attempts,
+                                      from_replica=self.replica_index)
                 self._handle, self.replica_index = \
                     self._pool._resubmit(self._kwargs)
 
@@ -186,6 +197,11 @@ class ReplicaPool:
         if self._pump is not None:
             self._pump.join(timeout=5.0)
             self._pump = None
+        if self.monitor is not None:
+            try:
+                self.monitor.close()
+            except Exception as e:  # pragma: no cover
+                logger.warning(f"serving monitor close failed: {e!r}")
 
     # -- routing ---------------------------------------------------------
 
@@ -283,6 +299,13 @@ class ReplicaPool:
                                 sum(kv) / len(kv) if kv else 0.0)
         self.metrics.set_prefix_stats(self._aggregate_prefix_stats())
         self.metrics.set_spec_stats(self._aggregate_spec_stats())
+        self.metrics.set_replica_stats([
+            {"name": b.name, "healthy": float(b.healthy()),
+             "queue_depth": float(b.queue_depth()),
+             "running": float(b.engine.num_running),
+             "outstanding_tokens": float(b.outstanding_tokens()),
+             "kv_utilization": b.kv_utilization()}
+            for b in self.replicas])
 
     def _pump_loop(self) -> None:
         while not self._pump_stop.wait(self.cfg.metrics_interval_s):

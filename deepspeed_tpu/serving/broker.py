@@ -33,7 +33,10 @@ from collections import deque
 from typing import Deque, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..inference.v2.engine import AdmissionError, InferenceEngineV2
-from ..utils.logging import logger
+from ..observability.recorder import recorder
+from ..observability.trace import tracer
+from ..utils import faults
+from ..utils.logging import logger, request_logger
 from .config import ServingConfig
 from .metrics import ServingMetrics
 
@@ -83,8 +86,10 @@ class _Request:
     state: RequestState = RequestState.QUEUED
     uid: Optional[int] = None
     delivered: int = 0
+    admit_ts: Optional[float] = None
     first_token_ts: Optional[float] = None
     last_token_ts: Optional[float] = None
+    finish_ts: Optional[float] = None
     finish_reason: Optional[str] = None
     error: Optional[str] = None
     out_q: "queue.Queue" = dataclasses.field(default_factory=queue.Queue)
@@ -207,6 +212,13 @@ class RequestBroker:
             self._queue.append(req)
             self._by_rid[req.rid] = req
             self._wake.notify_all()
+        tracer.add_event("request/submit", trace_id=req.rid,
+                         attrs={"replica": self.name,
+                                "prompt_tokens": len(prompt),
+                                "max_new_tokens": mnt})
+        request_logger(req.rid).info(
+            f"serving: submitted to {self.name} "
+            f"(prompt={len(prompt)} tok, budget={mnt})")
         return RequestHandle(self, req)
 
     def cancel(self, rid: str) -> bool:
@@ -226,6 +238,8 @@ class RequestBroker:
     def start(self) -> "RequestBroker":
         if self._thread is not None:
             return self
+        # injected hard-kills (utils/faults.py) leave a postmortem dump
+        recorder.install_crash_hook()
         self._thread = threading.Thread(
             target=self._run, name=f"dstpu-serving-{self.name}", daemon=True)
         self._thread.start()
@@ -269,6 +283,9 @@ class RequestBroker:
         """Simulate/execute hard replica death: the engine thread exits and
         every outstanding request fails with ``reason`` (the balancer
         retries those on surviving replicas)."""
+        recorder.record_event("broker/kill", replica=self.name, reason=reason)
+        tracer.add_event("broker/kill",
+                         attrs={"replica": self.name, "reason": reason})
         with self._wake:
             self._dead = reason
             self._wake.notify_all()
@@ -297,6 +314,7 @@ class RequestBroker:
     def _finalize_locked(self, req: _Request, reason: str,
                          detail: str = "") -> None:
         req.finish_reason = reason
+        req.finish_ts = time.monotonic()
         if reason in ("length", "stop"):
             req.state = RequestState.DONE
         elif reason == "cancelled":
@@ -309,13 +327,56 @@ class RequestBroker:
             # these and records the final outcome (completed or error)
             self.metrics.record_failover()
         else:
-            self.metrics.record_finish(reason)
+            self.metrics.record_finish(
+                reason, within_deadline=(req.deadline is None or
+                                         req.finish_ts <= req.deadline))
         if req.uid is not None:
             self._by_uid.pop(req.uid, None)
+        self._record_timeline(req)
+        request_logger(req.rid, req.uid).info(
+            f"serving: finished on {self.name} reason={reason} "
+            f"tokens={req.delivered}"
+            + (f" detail={detail}" if detail else ""))
         if req.state == RequestState.FAILED:
             req.out_q.put(("err", (reason, detail or reason)))
         else:
             req.out_q.put(("done", reason))
+
+    def _record_timeline(self, req: _Request) -> None:
+        """Emit the request's phase spans (queue → prefill → decode) to the
+        tracer and its full timeline to the flight recorder.  Retroactive:
+        the phase boundaries were observed across HTTP / engine threads, so
+        spans are recorded once all timestamps are known."""
+        spans = []
+        if req.admit_ts is not None:
+            spans.append(("request/queue", req.submit_ts, req.admit_ts))
+            if req.first_token_ts is not None:
+                spans.append(("request/prefill", req.admit_ts,
+                              req.first_token_ts))
+                spans.append(("request/decode", req.first_token_ts,
+                              req.finish_ts))
+            else:  # shed/cancelled before the first token came back
+                spans.append(("request/prefill", req.admit_ts, req.finish_ts))
+        else:  # never admitted: the whole life was queueing
+            spans.append(("request/queue", req.submit_ts, req.finish_ts))
+        root = tracer.add_span(
+            "request", req.submit_ts, req.finish_ts, trace_id=req.rid,
+            attrs={"replica": self.name, "uid": req.uid,
+                   "reason": req.finish_reason, "tokens_out": req.delivered})
+        parent = root.span_id if root is not None else None
+        for name, t0, t1 in spans:
+            tracer.add_span(name, t0, t1, trace_id=req.rid, parent_id=parent)
+        ttft_ms = (None if req.first_token_ts is None
+                   else (req.first_token_ts - req.submit_ts) * 1e3)
+        recorder.record_request({
+            "rid": req.rid, "uid": req.uid, "replica": self.name,
+            "submit_ts": req.submit_ts, "admit_ts": req.admit_ts,
+            "first_token_ts": req.first_token_ts, "finish_ts": req.finish_ts,
+            "finish_reason": req.finish_reason, "tokens_out": req.delivered,
+            "ttft_ms": ttft_ms,
+            "spans": [{"name": n, "t_start": t0, "t_end": t1}
+                      for n, t0, t1 in spans],
+        })
 
     def _apply_cancels_locked(self) -> None:
         for rid in self._cancels:
@@ -359,8 +420,12 @@ class RequestBroker:
             self._queue.popleft()
             req.uid = uid
             req.state = RequestState.PREFILL
+            req.admit_ts = now
             self._by_uid[uid] = req
             self.metrics.record_admit(now - req.submit_ts)
+            request_logger(req.rid, uid).info(
+                f"serving: admitted to {self.name} after "
+                f"{(now - req.submit_ts) * 1e3:.1f}ms in queue")
 
     def _fail_all_locked(self, reason: str) -> None:
         for req in list(self._by_rid.values()):
@@ -434,6 +499,7 @@ class RequestBroker:
                         self._wake.wait(self.cfg.idle_wait_s)
                         continue
                 # JAX outside the lock: submit/cancel stay non-blocking
+                faults.maybe_fail("serving.step")
                 out = self.engine.step(temperature=self.cfg.temperature)
                 self._dispatch(out, time.monotonic())
                 if self._own_gauges:
@@ -444,6 +510,9 @@ class RequestBroker:
                     self.metrics.set_spec_stats(self.engine.spec_stats())
         except Exception as e:  # engine fault → fail outstanding, die
             logger.error(f"serving broker {self.name} engine fault: {e!r}")
+            recorder.record_event("broker/engine_fault", replica=self.name,
+                                  error=repr(e))
+            recorder.dump(reason="engine_fault")
             with self._wake:
                 self._dead = f"engine_error: {e!r}"
                 self._fail_all_locked("engine_error")

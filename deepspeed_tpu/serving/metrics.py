@@ -6,8 +6,16 @@ instance per deployment, thread-safe), surfaced three ways:
 * ``to_events(step)`` — ``monitor.Event`` tuples for the CSV / TensorBoard /
   wandb sinks (``deepspeed_tpu/monitor/monitor.py``), same pipeline the
   training engine uses;
-* ``to_prometheus()`` — text exposition for the HTTP ``/metrics`` endpoint;
+* ``to_prometheus()`` — full text exposition for the HTTP ``/metrics``
+  endpoint (``observability/prometheus.py``: ``# HELP``/``# TYPE``
+  metadata, native histograms for TTFT/TPOT/queue-wait, per-replica
+  labeled gauges);
 * ``snapshot()`` — a plain dict (healthz, bench, tests).
+
+Rates (``goodput_rps``, ``tokens_per_s``) are computed over a **sliding
+window** (default 60 s), not process lifetime — a long-lived idle
+deployment decays to zero instead of averaging its history away; and
+goodput counts only completions that landed **within their SLO deadline**.
 """
 
 from __future__ import annotations
@@ -15,9 +23,11 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, List
+from typing import Callable, Deque, Dict, List, Optional, Sequence
 
 from ..monitor.monitor import Event, Monitor
+from ..observability.prometheus import (DEFAULT_MS_BUCKETS,
+                                        ExpositionBuilder, Histogram)
 
 
 def _percentile(samples: List[float], q: float) -> float:
@@ -45,25 +55,73 @@ class _Reservoir:
                 "count": float(len(s))}
 
 
+class _WindowRate:
+    """Events-per-second over a sliding window of 1-second buckets.
+
+    ``rate()`` divides the windowed sum by the window actually covered
+    (elapsed time when the process is younger than the window), so a fresh
+    deployment reports its true rate and an idle one decays to zero within
+    ``window_s`` — unlike the old lifetime average, which decayed toward
+    zero forever on any long-lived deployment."""
+
+    def __init__(self, window_s: float = 60.0):
+        self.window_s = float(window_s)
+        n = int(self.window_s) + 1
+        self._epochs = [-1] * n       # absolute 1s-bucket index per slot
+        self._sums = [0.0] * n
+        self._t0: Optional[float] = None
+
+    def add(self, value: float, now: float) -> None:
+        if self._t0 is None:
+            self._t0 = now
+        idx = int(now)
+        slot = idx % len(self._sums)
+        if self._epochs[slot] != idx:
+            self._epochs[slot] = idx
+            self._sums[slot] = 0.0
+        self._sums[slot] += value
+
+    def rate(self, now: float) -> float:
+        if self._t0 is None:
+            return 0.0
+        idx = int(now)
+        lo = idx - int(self.window_s)
+        total = sum(s for e, s in zip(self._epochs, self._sums) if lo < e <= idx)
+        covered = min(self.window_s, max(now - self._t0, 1.0))
+        return total / covered
+
+
 class ServingMetrics:
-    def __init__(self):
+    def __init__(self, rate_window_s: float = 60.0,
+                 now_fn: Callable[[], float] = time.monotonic):
         self._lock = threading.Lock()
+        self._now = now_fn
         self.ttft_ms = _Reservoir()   # submit → first generated token
         self.tpot_ms = _Reservoir()   # inter-token gap during decode
         self.queue_wait_ms = _Reservoir()  # submit → engine admission
+        # native histograms (full distributions for /metrics exposition)
+        self.ttft_hist = Histogram(DEFAULT_MS_BUCKETS)
+        self.tpot_hist = Histogram(DEFAULT_MS_BUCKETS)
+        self.queue_wait_hist = Histogram(DEFAULT_MS_BUCKETS)
         # counters (monotonic)
         self.submitted = 0
         self.rejected = 0        # queue-cap backpressure (429)
         self.completed = 0
+        self.completed_in_slo = 0  # completions within their deadline
         self.cancelled = 0
         self.failed = 0
         self.deadline_missed = 0  # shed by SLO deadline
         self.failovers = 0        # replica died mid-request; balancer retried
         self.tokens_out = 0
+        # sliding-window rates
+        self._win_goodput = _WindowRate(rate_window_s)
+        self._win_tokens = _WindowRate(rate_window_s)
         # gauges (set by the pool's metrics pump / broker loop)
         self.queue_depth = 0
         self.running = 0
         self.kv_utilization = 0.0
+        # per-replica labeled series for /metrics (set by the pool pump)
+        self.replica_stats: List[Dict[str, float]] = []
         # prefix-cache mirror (engine-owned counters, summed over replicas
         # by the pump; all zero when the cache is disabled)
         self.prefix: Dict[str, float] = {
@@ -79,7 +137,7 @@ class ServingMetrics:
             "accepted_tokens": 0, "emitted_tokens": 0,
             "acceptance_rate": 0.0, "fallback_steps": 0,
         }
-        self._t0 = time.monotonic()
+        self._t0 = self._now()
 
     # -- recording hooks (broker/balancer/server) ----------------------
 
@@ -94,25 +152,36 @@ class ServingMetrics:
     def record_admit(self, queue_wait_s: float) -> None:
         with self._lock:
             self.queue_wait_ms.add(queue_wait_s * 1e3)
+            self.queue_wait_hist.observe(queue_wait_s * 1e3)
 
     def record_first_token(self, ttft_s: float) -> None:
         with self._lock:
             self.ttft_ms.add(ttft_s * 1e3)
+            self.ttft_hist.observe(ttft_s * 1e3)
             self.tokens_out += 1
+            self._win_tokens.add(1.0, self._now())
 
     def record_token(self, gap_s: float) -> None:
         with self._lock:
             self.tpot_ms.add(gap_s * 1e3)
+            self.tpot_hist.observe(gap_s * 1e3)
             self.tokens_out += 1
+            self._win_tokens.add(1.0, self._now())
 
     def record_failover(self) -> None:
         with self._lock:
             self.failovers += 1
 
-    def record_finish(self, reason: str) -> None:
+    def record_finish(self, reason: str, within_deadline: bool = True) -> None:
+        """Terminal disposition.  ``within_deadline`` is the broker's
+        verdict (finish time vs the request's SLO deadline; True when no
+        deadline was set) — only those completions count toward goodput."""
         with self._lock:
             if reason in ("length", "stop"):
                 self.completed += 1
+                if within_deadline:
+                    self.completed_in_slo += 1
+                    self._win_goodput.add(1.0, self._now())
             elif reason == "cancelled":
                 self.cancelled += 1
             elif reason == "deadline":
@@ -127,6 +196,13 @@ class ServingMetrics:
             self.queue_depth = queue_depth
             self.running = running
             self.kv_utilization = kv_utilization
+
+    def set_replica_stats(self, stats: Sequence[Dict[str, float]]) -> None:
+        """Per-replica gauge series for /metrics labels; each entry carries
+        ``name`` plus numeric gauges (healthy, queue_depth, running,
+        outstanding_tokens, kv_utilization)."""
+        with self._lock:
+            self.replica_stats = [dict(s) for s in stats]
 
     def set_prefix_stats(self, stats: Dict[str, float]) -> None:
         """Mirror engine prefix-cache stats (see
@@ -150,19 +226,22 @@ class ServingMetrics:
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
-            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            now = self._now()
             out: Dict[str, float] = {
                 "submitted": self.submitted, "rejected": self.rejected,
-                "completed": self.completed, "cancelled": self.cancelled,
+                "completed": self.completed,
+                "completed_in_slo": self.completed_in_slo,
+                "cancelled": self.cancelled,
                 "failed": self.failed,
                 "deadline_missed": self.deadline_missed,
                 "failovers": self.failovers,
                 "tokens_out": self.tokens_out,
                 "queue_depth": self.queue_depth, "running": self.running,
                 "kv_utilization": self.kv_utilization,
-                # goodput: requests that completed within their SLO, per sec
-                "goodput_rps": self.completed / elapsed,
-                "tokens_per_s": self.tokens_out / elapsed,
+                # goodput: within-SLO completions per second over the
+                # sliding rate window (not process lifetime)
+                "goodput_rps": self._win_goodput.rate(now),
+                "tokens_per_s": self._win_tokens.rate(now),
             }
             for name, res in (("ttft_ms", self.ttft_ms),
                               ("tpot_ms", self.tpot_ms),
@@ -179,11 +258,70 @@ class ServingMetrics:
         return [(f"serving/{k}", float(v), step)
                 for k, v in self.snapshot().items()]
 
+    _COUNTER_HELP = {
+        "submitted": "Requests accepted into an admission queue.",
+        "rejected": "Requests rejected by queue backpressure (HTTP 429).",
+        "completed": "Requests finished with reason length/stop.",
+        "completed_in_slo": "Completions within their SLO deadline.",
+        "cancelled": "Requests cancelled by the client.",
+        "failed": "Requests that terminally failed (incl. deadline sheds).",
+        "deadline_missed": "Requests shed past their SLO deadline.",
+        "failovers": "Mid-request replica deaths retried by the balancer.",
+        "tokens_out": "Generated tokens delivered to clients.",
+    }
+    _GAUGE_HELP = {
+        "queue_depth": "Requests queued (accepted, not yet admitted).",
+        "running": "Sequences running in the engines.",
+        "kv_utilization": "Fraction of KV blocks unavailable to new work.",
+        "goodput_rps": "Within-SLO completions/s over the sliding window.",
+        "tokens_per_s": "Delivered tokens/s over the sliding window.",
+    }
+
     def to_prometheus(self) -> str:
-        lines = []
-        for k, v in self.snapshot().items():
-            lines.append(f"dstpu_serving_{k} {v}")
-        return "\n".join(lines) + "\n"
+        """Text exposition (version 0.0.4) with HELP/TYPE metadata, native
+        histograms, and per-replica labeled gauges; validated by the strict
+        parser in ``observability/prometheus.py``."""
+        snap = self.snapshot()
+        with self._lock:
+            replica_stats = [dict(s) for s in self.replica_stats]
+        b = ExpositionBuilder()
+        pre = "dstpu_serving_"
+        for k, help_text in self._COUNTER_HELP.items():
+            b.counter(pre + k, help_text, snap[k])
+        for k, help_text in self._GAUGE_HELP.items():
+            b.gauge(pre + k, help_text, snap[k])
+        # latency summaries: percentile gauges (dashboards) + histograms
+        # (aggregation); the reservoir's windowed count/mean stay
+        # snapshot()-only — the histogram _count/_sum are authoritative here
+        for fam, res, hist, what in (
+                ("ttft_ms", self.ttft_ms, self.ttft_hist,
+                 "submit to first generated token"),
+                ("tpot_ms", self.tpot_ms, self.tpot_hist,
+                 "inter-token gap during decode"),
+                ("queue_wait_ms", self.queue_wait_ms, self.queue_wait_hist,
+                 "submit to engine admission")):
+            for q in ("p50", "p95", "p99"):
+                b.gauge(f"{pre}{fam}_{q}",
+                        f"{q} {what} (ms, recent-sample reservoir).",
+                        snap[f"{fam}_{q}"])
+            b.histogram(pre + fam, f"Histogram of {what} (ms).", hist)
+        for k in self.prefix:
+            b.gauge(f"{pre}prefix_{k}",
+                    f"Prefix cache: {k.replace('_', ' ')}.",
+                    snap[f"prefix_{k}"])
+        for k in self.spec:
+            b.gauge(f"{pre}spec_{k}",
+                    f"Speculative decoding: {k.replace('_', ' ')}.",
+                    snap[f"spec_{k}"])
+        if replica_stats:
+            keys = [k for k in replica_stats[0] if k != "name"]
+            for k in keys:
+                b.gauge_series(
+                    f"{pre}replica_{k}",
+                    f"Per-replica {k.replace('_', ' ')}.",
+                    [({"replica": str(s.get("name", i))}, float(s[k]))
+                     for i, s in enumerate(replica_stats)])
+        return b.render()
 
     def emit_to(self, monitor: Monitor, step: int) -> None:
         if monitor is not None and getattr(monitor, "enabled", False):

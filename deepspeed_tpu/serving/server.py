@@ -16,7 +16,14 @@ Endpoints:
 * ``POST /v1/cancel`` — ``{"id": "..."}`` aborts an in-flight request (the
   other cancel path is simply closing the streaming connection).
 * ``GET /healthz`` — replica health + pool state (503 when no replica).
-* ``GET /metrics`` — Prometheus text exposition of the serving metrics.
+* ``GET /metrics`` — Prometheus text exposition of the serving metrics
+  (HELP/TYPE, TTFT/TPOT/queue-wait histograms, per-replica labels).
+* ``GET /debug/requests`` — flight-recorder snapshot: recent request
+  timelines, engine steps, and infra events.
+* ``GET /debug/trace`` — tracer ring as Chrome/Perfetto trace-event JSON
+  (load at https://ui.perfetto.dev).
+* ``GET /debug/profile?seconds=N`` — on-demand ``jax.profiler`` capture;
+  responds with the directory holding the profile.
 
 Backpressure: when every healthy replica's bounded admission queue is full,
 ``/v1/completions`` returns **429** with ``Retry-After`` instead of queueing
@@ -34,7 +41,10 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs, urlsplit
 
+from ..observability.recorder import recorder
+from ..observability.trace import tracer
 from ..utils.logging import logger
 from ..utils.proc import terminate_procs
 from .balancer import BalancedHandle, NoReplicaError, ReplicaPool
@@ -143,19 +153,55 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes ----------------------------------------------------------
 
     def do_GET(self):  # noqa: N802 (stdlib casing)
-        if self.path == "/healthz":
+        parts = urlsplit(self.path)
+        path, query = parts.path, parse_qs(parts.query)
+        if path == "/healthz":
             health = self.server.pool.health()
             health["metrics"] = self.server.metrics.snapshot()
             self._json(200 if health["status"] == "ok" else 503, health)
-        elif self.path == "/metrics":
+        elif path == "/metrics":
             body = self.server.metrics.to_prometheus().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path == "/debug/requests":
+            self._json(200, recorder.snapshot())
+        elif path == "/debug/trace":
+            self._json(200, tracer.to_chrome_trace())
+        elif path == "/debug/profile":
+            self._debug_profile(query)
         else:
             self._error(404, f"no route {self.path}", "not_found")
+
+    def _debug_profile(self, query: dict) -> None:
+        """On-demand ``jax.profiler`` capture: blocks this HTTP thread for
+        ``seconds`` (engine threads keep serving) and returns the directory
+        holding the TensorBoard-loadable profile."""
+        import tempfile
+
+        import jax
+
+        try:
+            seconds = float(query.get("seconds", ["1.0"])[0])
+        except ValueError:
+            self._error(400, "seconds must be a number",
+                        "invalid_request_error")
+            return
+        if not 0.0 < seconds <= 60.0:
+            self._error(400, "seconds must be in (0, 60]",
+                        "invalid_request_error")
+            return
+        out_dir = tempfile.mkdtemp(prefix="dstpu_profile_")
+        try:
+            with tracer.span("debug/profile", seconds=seconds):
+                with jax.profiler.trace(out_dir):
+                    time.sleep(seconds)
+        except Exception as e:  # profiler unavailable on this backend
+            self._error(503, f"profiler failed: {e!r}", "profiler_error")
+            return
+        self._json(200, {"profile_dir": out_dir, "seconds": seconds})
 
     def do_POST(self):  # noqa: N802
         try:

@@ -27,6 +27,12 @@ Spec grammar, per site: ``KIND[:ARG][@HIT]``
     per-site, so ``exit@2`` deterministically kills the second save.
 
 Tests can assert on ``faults.hits(site)`` / ``faults.fired(site)``.
+
+Crash hooks: callables registered with :func:`add_crash_hook` run just
+before an ``exit`` spec's ``os._exit`` — the flight recorder
+(``observability/recorder.py``) uses this to leave a postmortem dump on
+injected hard-kills.  Hooks must be fast and must not raise (failures are
+swallowed so they can't mask the kill).
 """
 
 from __future__ import annotations
@@ -35,7 +41,7 @@ import dataclasses
 import os
 import threading
 import time
-from typing import Dict, Optional, Union
+from typing import Callable, Dict, List, Optional, Union
 
 from .logging import logger
 
@@ -72,6 +78,7 @@ class FaultInjector:
         self._hits: Dict[str, int] = {}
         self._fired: Dict[str, int] = {}
         self._env_loaded = False
+        self._crash_hooks: List[Callable[[str], None]] = []
 
     # -- arming ----------------------------------------------------------
     def configure(self, spec: Union[str, Dict[str, str]]) -> None:
@@ -103,6 +110,13 @@ class FaultInjector:
     def fired(self, site: str) -> int:
         return self._fired.get(site, 0)
 
+    def add_crash_hook(self, fn: Callable[[str], None]) -> None:
+        """Register ``fn(site)`` to run before an injected hard-kill's
+        ``os._exit``.  Idempotent per callable."""
+        with self._lock:
+            if fn not in self._crash_hooks:
+                self._crash_hooks.append(fn)
+
     # -- sites -----------------------------------------------------------
     def maybe_fail(self, site: str) -> None:
         """Fault site for exit / ioerror / delay kinds (truncate specs are
@@ -114,6 +128,13 @@ class FaultInjector:
             code = int(spec.arg) if spec.arg else 70
             logger.error(f"fault injection: hard-killing process at {site!r} "
                          f"(os._exit({code}))")
+            with self._lock:
+                hooks = list(self._crash_hooks)
+            for fn in hooks:
+                try:
+                    fn(site)
+                except Exception:  # noqa: BLE001 — must not mask the kill
+                    pass
             os._exit(code)
         if spec.kind == "ioerror":
             raise IOError(f"injected fault at {site!r}"
@@ -165,3 +186,4 @@ hits = _INJECTOR.hits
 fired = _INJECTOR.fired
 maybe_fail = _INJECTOR.maybe_fail
 maybe_truncate = _INJECTOR.maybe_truncate
+add_crash_hook = _INJECTOR.add_crash_hook

@@ -60,6 +60,22 @@ def should_log_le(max_log_level: str) -> bool:
     return logger.getEffectiveLevel() <= wanted
 
 
+class _RequestLogAdapter(logging.LoggerAdapter):
+    """Prefixes every line with ``[rid=... uid=...]`` so one request's log
+    lines can be grepped across broker / balancer / engine threads."""
+
+    def process(self, msg, kwargs):
+        rid = self.extra.get("rid")
+        uid = self.extra.get("uid")
+        tag = f"[rid={rid}]" if not uid else f"[rid={rid} uid={uid}]"
+        return f"{tag} {msg}", kwargs
+
+
+def request_logger(rid: str, uid: Optional[str] = None) -> logging.LoggerAdapter:
+    """Logger whose lines carry the request id (and user id when known)."""
+    return _RequestLogAdapter(logger, {"rid": rid, "uid": uid})
+
+
 def warning_once(message: str) -> None:
     _warning_once_impl(message)
 

@@ -86,6 +86,17 @@ then
     exit 2
 fi
 
+# observability suite: imports the tracer/recorder/prometheus package, the
+# /debug server surfaces, and the flight-dump fault plumbing
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_observability.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_observability.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi
