@@ -143,7 +143,10 @@ class ElasticAgent:
         faults.maybe_fail("elastic.launch")
         full = dict(os.environ)
         full.update(env)
-        return subprocess.Popen(self.program, env=full)
+        # own session → own process group: generation teardown can killpg
+        # the whole worker tree (a worker's forked helpers included)
+        return subprocess.Popen(self.program, env=full,
+                                start_new_session=True)
 
     # -- checkpoint validation (pre-relaunch) ---------------------------
 
@@ -208,7 +211,11 @@ class ElasticAgent:
                               members=list(members))
 
     def _stop_group(self) -> None:
-        terminate_procs(self.procs, term_timeout_s=self.cfg.term_timeout_s)
+        # group-wide: workers launched with start_new_session=True lead
+        # their own process groups (custom launch_fns that don't opt in
+        # fall back to direct signals inside terminate_procs)
+        terminate_procs(self.procs, term_timeout_s=self.cfg.term_timeout_s,
+                        process_group=True)
         self.procs = []
 
     # -- the supervision loop -------------------------------------------

@@ -5,6 +5,12 @@ Layers, bottom-up:
 * :mod:`.broker` — request lifecycle over one continuous-batching
   :class:`~deepspeed_tpu.inference.v2.engine.InferenceEngineV2` (bounded
   admission queue, deadlines, cancellation, streaming delivery);
+* :mod:`.transport` — the replica seam: in-process engine threads or
+  out-of-process worker processes behind one interface;
+* :mod:`.worker` — the replica worker process (own engine, own XLA
+  runtime) for ``--replica_transport subprocess``;
+* :mod:`.supervisor` — heartbeat health-checking, hung-replica detection,
+  respawn with backoff, crash-loop circuit breaker;
 * :mod:`.balancer` — replica pool with least-outstanding-tokens routing,
   health checks, and transparent retry on replica death;
 * :mod:`.server` — OpenAI-compatible HTTP front (``/v1/completions``
@@ -27,11 +33,14 @@ from .config import ServingConfig
 from .metrics import ServingMetrics
 from .server import (ServingHTTPServer, create_server,
                      launch_server_subprocess, stop_server)
+from .supervisor import ReplicaSupervisor
+from .transport import (InProcessReplica, ReplicaTransport, SubprocessReplica)
 
 __all__ = [
-    "BalancedHandle", "BrokerStoppedError", "InvalidRequestError",
-    "NoReplicaError", "QueueFullError", "ReplicaPool", "RequestBroker",
+    "BalancedHandle", "BrokerStoppedError", "InProcessReplica",
+    "InvalidRequestError", "NoReplicaError", "QueueFullError", "ReplicaPool",
+    "ReplicaSupervisor", "ReplicaTransport", "RequestBroker",
     "RequestFailedError", "RequestHandle", "RequestState", "ServingConfig",
-    "ServingHTTPServer", "ServingMetrics", "create_server",
-    "launch_server_subprocess", "stop_server",
+    "ServingHTTPServer", "ServingMetrics", "SubprocessReplica",
+    "create_server", "launch_server_subprocess", "stop_server",
 ]

@@ -1,26 +1,33 @@
-"""Replica load balancer: MII-style deployment over N engine replicas.
+"""Replica load balancer: MII-style deployment over N replicas.
 
 Capability analogue of DeepSpeed-MII's ``LoadBalancer`` process
 (``mii/grpc_related/``: a front that round-robins REST/gRPC requests over
-replica processes). TPU adaptation: replicas are in-process
-:class:`~deepspeed_tpu.serving.broker.RequestBroker` instances sharing one
-(immutable) param pytree — JAX arrays are freely shared across threads, so
-one host serves N independent continuous-batching engines without N copies
-of the weights.  Multi-host deployments front one HTTP server per host
-(``python -m deepspeed_tpu.serving.server``) launched/supervised by the
-elasticity machinery; teardown goes through the shared
-``utils.proc.terminate_procs`` grace-period helper either way.
+replica processes).  The pool routes over :class:`~deepspeed_tpu.serving.
+transport.ReplicaTransport` objects and never touches an engine directly,
+so the same routing and failover drive both deployments:
+
+* ``inprocess`` — :class:`~deepspeed_tpu.serving.broker.RequestBroker`
+  engine threads sharing one (immutable) param pytree: JAX arrays are
+  freely shared across threads, so one host serves N independent
+  continuous-batching engines without N copies of the weights.
+* ``subprocess`` — out-of-process workers (their own XLA runtimes) behind
+  :class:`~deepspeed_tpu.serving.transport.SubprocessReplica`, watched by
+  the :class:`~deepspeed_tpu.serving.supervisor.ReplicaSupervisor` — this
+  matches the reference architecture (MII fronts replica *processes*) and
+  buys fault isolation: a replica crash/hang costs one worker, never the
+  front.
 
 Routing is **least-outstanding-tokens** (queued prompt tokens + undelivered
 generation budget), a closer proxy for engine load than request count when
 lengths are mixed.  A replica that dies mid-request fails its streams with
 ``replica_dead``; the pool transparently resubmits on a surviving replica
-with backoff, replaying the (deterministic, greedy) prefix and skipping the
-tokens the client already received.
+with decorrelated-jitter backoff, replaying the (deterministic, greedy)
+prefix and skipping the tokens the client already received.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
@@ -30,9 +37,10 @@ from ..observability.recorder import recorder
 from ..observability.trace import tracer
 from ..utils.logging import logger, request_logger
 from .broker import (BrokerStoppedError, QueueFullError, RequestBroker,
-                     RequestFailedError, RequestHandle)
+                     RequestFailedError)
 from .config import ServingConfig
 from .metrics import ServingMetrics
+from .transport import (InProcessReplica, ReplicaTransport, SubprocessReplica)
 
 
 class NoReplicaError(RuntimeError):
@@ -44,13 +52,13 @@ _RETRYABLE = ("replica_dead", "engine_error", "shutdown")
 
 class BalancedHandle:
     """A request handle that survives replica death: wraps the current
-    replica's :class:`RequestHandle` and, on a retryable failure, resubmits
-    to another healthy replica, skipping already-delivered tokens (greedy
-    decode replays deterministically; with temperature > 0 the retried
-    suffix is a fresh sample)."""
+    replica's handle and, on a retryable failure, resubmits to another
+    healthy replica, skipping already-delivered tokens (greedy decode
+    replays deterministically; with temperature > 0 the retried suffix is
+    a fresh sample)."""
 
-    def __init__(self, pool: "ReplicaPool", handle: RequestHandle,
-                 replica_index: int, submit_kwargs: dict):
+    def __init__(self, pool: "ReplicaPool", handle, replica_index: int,
+                 submit_kwargs: dict):
         self._pool = pool
         self._handle = handle
         self.replica_index = replica_index
@@ -74,8 +82,19 @@ class BalancedHandle:
         self._cancelled = True
         self._handle.cancel()
 
+    def _backoff(self, prev: float) -> float:
+        """Decorrelated-jitter failover backoff: ``min(cap, uniform(base,
+        3 * prev))``.  When a replica dies, every stream it carried fails
+        over at once — jitter de-synchronizes the stampede onto the
+        survivors, and the cap bounds worst-case added latency."""
+        cfg = self._pool.cfg
+        base = cfg.retry_backoff_s
+        return min(cfg.retry_backoff_max_s,
+                   random.uniform(base, max(base, 3.0 * prev)))
+
     def tokens(self, timeout: Optional[float] = None) -> Iterator[int]:
         attempts = 0
+        sleep_s = self._pool.cfg.retry_backoff_s
         while True:
             seen_this_handle = 0
             try:
@@ -93,10 +112,11 @@ class BalancedHandle:
                         self._pool.metrics.record_finish("error")
                     raise
                 attempts += 1
-                time.sleep(self._pool.cfg.retry_backoff_s * attempts)
+                sleep_s = self._backoff(sleep_s)
+                time.sleep(sleep_s)
                 request_logger(self._handle.rid).warning(
                     f"serving: retrying after {e.reason} "
-                    f"(attempt {attempts})")
+                    f"(attempt {attempts}, backoff {sleep_s * 1e3:.0f}ms)")
                 tracer.add_event("request/failover",
                                  trace_id=self._handle.rid,
                                  attrs={"reason": e.reason,
@@ -114,14 +134,18 @@ class BalancedHandle:
 
 
 class ReplicaPool:
-    """Owns the replica brokers, routes requests, pumps metrics/health."""
+    """Owns the replica transports, routes requests, pumps metrics/health,
+    and (for subprocess replicas) runs the supervisor."""
 
-    def __init__(self, brokers: Sequence[RequestBroker], config: ServingConfig,
+    def __init__(self, replicas: Sequence, config: ServingConfig,
                  metrics: Optional[ServingMetrics] = None,
                  monitor: Optional[Monitor] = None):
-        if not brokers:
+        if not replicas:
             raise ValueError("need at least one replica")
-        self.replicas: List[RequestBroker] = list(brokers)
+        # bare brokers (pre-transport callers, tests) get wrapped in place
+        self.replicas: List[ReplicaTransport] = [
+            InProcessReplica(r) if isinstance(r, RequestBroker) else r
+            for r in replicas]
         self.cfg = config
         self.metrics = metrics or ServingMetrics()
         self.monitor = monitor
@@ -131,20 +155,50 @@ class ReplicaPool:
         self._pump: Optional[threading.Thread] = None
         self._pump_stop = threading.Event()
         self._emit_step = 0
+        # last-known per-replica health entries: the health endpoint must
+        # answer (with a stale flag) even when a replica can't
+        self._last_health: Dict[int, dict] = {}
+        self.supervisor = None
+        if any(isinstance(t, SubprocessReplica) for t in self.replicas):
+            from .supervisor import ReplicaSupervisor
+
+            self.supervisor = ReplicaSupervisor(
+                [t for t in self.replicas
+                 if isinstance(t, SubprocessReplica)],
+                config, metrics=self.metrics)
 
     @classmethod
     def build(cls, engine_factory: Callable[[], "object"],
               config: ServingConfig,
               metrics: Optional[ServingMetrics] = None,
               monitor: Optional[Monitor] = None) -> "ReplicaPool":
-        """Construct ``config.num_replicas`` brokers from an engine factory
-        (each call must return a FRESH InferenceEngineV2 over shared
-        params)."""
+        """In-process pool: ``config.num_replicas`` brokers from an engine
+        factory (each call must return a FRESH InferenceEngineV2 over
+        shared params)."""
         metrics = metrics or ServingMetrics()
         brokers = [RequestBroker(engine_factory(), config, metrics=metrics,
                                  name=f"replica{i}", own_gauges=False)
                    for i in range(config.num_replicas)]
         return cls(brokers, config, metrics=metrics, monitor=monitor)
+
+    @classmethod
+    def build_subprocess(cls, worker_argv: Sequence[str],
+                         config: ServingConfig,
+                         metrics: Optional[ServingMetrics] = None,
+                         monitor: Optional[Monitor] = None,
+                         extra_env: Optional[Dict[str, str]] = None,
+                         ) -> "ReplicaPool":
+        """Fault-isolated pool: ``config.num_replicas`` worker processes
+        (``python -m deepspeed_tpu.serving.worker <worker_argv>``), each
+        with its own engine and XLA runtime, under supervision.
+        ``extra_env`` is merged into every worker's environment on each
+        (re)spawn — chaos tests arm persistent ``DSTPU_FAULTS`` there."""
+        metrics = metrics or ServingMetrics()
+        transports = [SubprocessReplica(worker_argv, config,
+                                        name=f"replica{i}", metrics=metrics,
+                                        extra_env=extra_env)
+                      for i in range(config.num_replicas)]
+        return cls(transports, config, metrics=metrics, monitor=monitor)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -163,33 +217,73 @@ class ReplicaPool:
         return self
 
     def start_engines(self) -> None:
-        for b in self.replicas:
-            b.start()
+        for t in self.replicas:
+            t.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
+
+    def wait_ready(self, timeout: Optional[float] = None,
+                   min_replicas: int = 1) -> int:
+        """Block until every replica is healthy (or ``timeout``); returns
+        the healthy count.  Subprocess workers pay JAX import + engine
+        build after ``start()`` — the HTTP front waits here before
+        printing its ready line.  Raises :class:`NoReplicaError` when
+        fewer than ``min_replicas`` came up."""
+        timeout = self.cfg.spawn_timeout_s if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            n = len(self.healthy_replicas())
+            if n >= len(self.replicas):
+                return n
+            # slots retired by the circuit breaker will never come up:
+            # don't wait for them (degraded but serving)
+            retired = sum(1 for t in self.replicas
+                          if getattr(t, "circuit_open", False))
+            if retired and n >= max(min_replicas,
+                                    len(self.replicas) - retired):
+                return n
+            time.sleep(0.02)
+        n = len(self.healthy_replicas())
+        if n < min_replicas:
+            raise NoReplicaError(
+                f"only {n}/{len(self.replicas)} replicas ready "
+                f"after {timeout:.0f}s")
+        return n
 
     def healthy_replicas(self) -> List[int]:
-        return [i for i, b in enumerate(self.replicas) if b.healthy()]
+        return [i for i, t in enumerate(self.replicas) if t.healthy()]
 
     def kill_replica(self, index: int, reason: str = "replica_dead") -> None:
         self.replicas[index].kill(reason)
 
     def drain(self, timeout: Optional[float] = None) -> None:
         """Graceful shutdown: stop accepting, let outstanding requests
-        finish inside the grace window, then stop the engine threads."""
+        finish inside the grace window, then stop the replicas."""
         self._accepting = False
+        if self.supervisor is not None:  # no respawns during teardown
+            self.supervisor.stop()
         timeout = self.cfg.drain_timeout_s if timeout is None else timeout
         deadline = time.monotonic() + timeout
-        for b in self.replicas:
-            if b.healthy():
-                b.stop(drain=True,
+        for t in self.replicas:
+            try:
+                t.stop(drain=True,
                        timeout=max(0.0, deadline - time.monotonic()))
+            except Exception as e:  # noqa: BLE001 — a dead replica must
+                # not block draining the healthy ones
+                logger.warning(f"serving drain: {t.name} stop failed: {e!r}")
         self._stop_pump()
 
     def shutdown(self) -> None:
         """Immediate shutdown: outstanding requests fail with ``shutdown``."""
         self._accepting = False
-        for b in self.replicas:
-            if b.healthy():
-                b.stop(drain=False, timeout=10.0)
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        for t in self.replicas:
+            try:
+                t.stop(drain=False, timeout=10.0)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"serving shutdown: {t.name} stop failed: "
+                               f"{e!r}")
         self._stop_pump()
 
     def _stop_pump(self) -> None:
@@ -227,7 +321,14 @@ class ReplicaPool:
     def _resubmit(self, kwargs: dict, fresh: bool = False):
         """Place (or re-place after replica death) a request; tries every
         healthy replica before giving up. Queue-full only counts as
-        backpressure when EVERY healthy replica's queue is full."""
+        backpressure when EVERY healthy replica's queue is full.
+
+        A FRESH submit with no healthy replica fails fast (503
+        backpressure); a failover resubmit waits up to ``failover_wait_s``
+        for the supervisor to respawn one — the in-flight stream rides out
+        a total-outage window instead of dying with its last replica."""
+        deadline = (None if fresh
+                    else time.monotonic() + self.cfg.failover_wait_s)
         tried: List[int] = []
         last: Optional[Exception] = None
         while True:
@@ -236,6 +337,12 @@ class ReplicaPool:
             except NoReplicaError:
                 if isinstance(last, QueueFullError):
                     raise last
+                if (deadline is not None and self._accepting
+                        and time.monotonic() < deadline):
+                    # a respawned generation gets a clean retry slate
+                    tried, last = [], None
+                    time.sleep(0.1)
+                    continue
                 raise
             tried.append(idx)
             try:
@@ -246,29 +353,54 @@ class ReplicaPool:
     # -- observability ---------------------------------------------------
 
     def queue_depth(self) -> int:
-        return sum(b.queue_depth() for b in self.replicas)
+        return sum(t.queue_depth() for t in self.replicas)
+
+    def _replica_health(self, i: int, t: ReplicaTransport) -> dict:
+        """One replica's health entry; never raises.  A replica that can't
+        answer (dead engine, unreachable worker) gets its last-known entry
+        back with ``stale: true`` — the endpoint's contract is to always
+        describe the whole fleet."""
+        try:
+            entry = {
+                "index": i, "name": t.name, "healthy": t.healthy(),
+                "queue_depth": t.queue_depth(),
+                "outstanding_tokens": t.outstanding_tokens(),
+                "running": t.num_running(),
+                "kv_utilization": round(t.kv_utilization(), 4),
+                "prefix": t.prefix_stats(),
+                "spec": t.spec_stats(),
+                "stale": False,
+            }
+            entry.update(t.describe())
+            self._last_health[i] = entry
+            return entry
+        except Exception as e:  # noqa: BLE001 — dead replicas still report
+            prev = dict(self._last_health.get(i, {"index": i,
+                                                  "name": t.name}))
+            prev.update({"healthy": False, "stale": True,
+                         "error": repr(e)})
+            return prev
 
     def health(self) -> dict:
-        reps = []
-        for i, b in enumerate(self.replicas):
-            reps.append({
-                "index": i, "healthy": b.healthy(),
-                "queue_depth": b.queue_depth(),
-                "outstanding_tokens": b.outstanding_tokens(),
-                "running": b.engine.num_running,
-                "kv_utilization": round(b.kv_utilization(), 4),
-                "prefix": b.engine.prefix_stats(),
-                "spec": b.engine.spec_stats(),
-            })
-        return {"status": "ok" if self.healthy_replicas() else "down",
-                "accepting": self._accepting, "replicas": reps}
+        reps = [self._replica_health(i, t)
+                for i, t in enumerate(self.replicas)]
+        healthy = [r for r in reps if r.get("healthy")]
+        kv = [r.get("kv_utilization", 0.0) for r in healthy]
+        return {"status": "ok" if healthy else "down",
+                "accepting": self._accepting,
+                "healthy_replicas": len(healthy),
+                "num_replicas": len(self.replicas),
+                # live capacity signal for graceful degradation: mean KV
+                # pressure across the replicas actually taking traffic
+                "kv_utilization": round(sum(kv) / len(kv), 4) if kv else 0.0,
+                "replicas": reps}
 
     def _aggregate_prefix_stats(self) -> Dict[str, float]:
         """Sum engine prefix-cache stats over replicas; hit_rate is
         recomputed from the pooled counts."""
         agg: Dict[str, float] = {}
-        for b in self.replicas:
-            for k, v in b.engine.prefix_stats().items():
+        for t in self.replicas:
+            for k, v in t.prefix_stats().items():
                 agg[k] = agg.get(k, 0.0) + v
         agg["enabled"] = float(bool(agg.get("enabled")))
         lookups = agg.get("lookups", 0.0)
@@ -280,36 +412,38 @@ class ReplicaPool:
         acceptance_rate is recomputed from the pooled token counts and ``k``
         is reported once (replicas share one config), not summed."""
         agg: Dict[str, float] = {}
-        for b in self.replicas:
-            for k, v in b.engine.spec_stats().items():
+        for t in self.replicas:
+            for k, v in t.spec_stats().items():
                 agg[k] = agg.get(k, 0.0) + v
         agg["enabled"] = float(bool(agg.get("enabled")))
         if self.replicas:
-            agg["k"] = self.replicas[0].engine.spec_stats()["k"]
+            agg["k"] = self.replicas[0].spec_stats().get("k", 0)
         proposed = agg.get("proposed_tokens", 0.0)
         agg["acceptance_rate"] = (agg.get("accepted_tokens", 0.0) / proposed
                                   if proposed else 0.0)
         return agg
 
     def _update_gauges(self) -> None:
-        running = sum(b.engine.num_running for b in self.replicas)
-        kv = [b.kv_utilization() for i, b in enumerate(self.replicas)
-              if b.healthy()]
+        running = sum(t.num_running() for t in self.replicas)
+        kv = [t.kv_utilization() for t in self.replicas if t.healthy()]
         self.metrics.set_gauges(self.queue_depth(), running,
                                 sum(kv) / len(kv) if kv else 0.0)
         self.metrics.set_prefix_stats(self._aggregate_prefix_stats())
         self.metrics.set_spec_stats(self._aggregate_spec_stats())
         self.metrics.set_replica_stats([
-            {"name": b.name, "healthy": float(b.healthy()),
-             "queue_depth": float(b.queue_depth()),
-             "running": float(b.engine.num_running),
-             "outstanding_tokens": float(b.outstanding_tokens()),
-             "kv_utilization": b.kv_utilization()}
-            for b in self.replicas])
+            {"name": t.name, "healthy": float(t.healthy()),
+             "queue_depth": float(t.queue_depth()),
+             "running": float(t.num_running()),
+             "outstanding_tokens": float(t.outstanding_tokens()),
+             "kv_utilization": t.kv_utilization()}
+            for t in self.replicas])
 
     def _pump_loop(self) -> None:
         while not self._pump_stop.wait(self.cfg.metrics_interval_s):
-            self._update_gauges()
+            try:
+                self._update_gauges()
+            except Exception as e:  # a dying replica must not kill the pump
+                logger.warning(f"serving gauge update failed: {e!r}")
             self._emit_step += 1
             try:
                 self.metrics.emit_to(self.monitor, self._emit_step)

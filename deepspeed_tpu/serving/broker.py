@@ -163,6 +163,11 @@ class RequestBroker:
         self._stop = False
         self._drain = False
         self._dead: Optional[str] = None  # kill/crash reason
+        # liveness for out-of-process supervision: the engine loop stamps
+        # this every iteration, so a wedged step() (hung compile, stuck
+        # device) shows up as a growing progress age while busy() is True
+        self.last_progress_ts = time.monotonic()
+        self._busy = False
 
     # -- client surface (any thread) ------------------------------------
 
@@ -251,6 +256,15 @@ class RequestBroker:
 
     def queue_depth(self) -> int:
         return len(self._queue)
+
+    def progress_age(self) -> float:
+        """Seconds since the engine loop last completed an iteration."""
+        return time.monotonic() - self.last_progress_ts
+
+    def busy(self) -> bool:
+        """True while the engine loop has admitted/queued work — a large
+        ``progress_age`` is only a hang symptom when there IS work."""
+        return self._busy
 
     def outstanding(self) -> int:
         """Live (non-terminal) requests."""
@@ -484,6 +498,8 @@ class RequestBroker:
                     self._reap_terminal_locked()
                     has_work = bool(self.engine.running or
                                     self.engine.waiting or self._queue)
+                    self.last_progress_ts = now
+                    self._busy = has_work
                     if self._stop and (not self._drain or not has_work):
                         if not self._drain:
                             self._fail_all_locked("shutdown")

@@ -31,12 +31,58 @@ class ServingConfig:
     stop_token_ids: Tuple[int, ...] = ()
     #: engine-thread idle wait between polls when there is no work
     idle_wait_s: float = 0.005
-    #: replica pool size (in-process engine instances sharing params)
+    #: replica pool size (in-process engine instances sharing params, or
+    #: out-of-process workers — see ``replica_transport``)
     num_replicas: int = 1
     #: transparent retries when a replica dies mid-request
     retry_limit: int = 2
+    #: failover backoff: exponential with decorrelated jitter —
+    #: sleep_n = min(retry_backoff_max_s, uniform(retry_backoff_s,
+    #: 3 * sleep_{n-1})) — so simultaneous failovers from a dead replica
+    #: don't stampede the survivor in lockstep.
     retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    #: how long a failing-over request will wait for SOME replica to come
+    #: back before giving up.  Covers the window where every replica is
+    #: down at once (e.g. the last survivor died while the others respawn):
+    #: in-flight streams ride out a respawn instead of failing.  Fresh
+    #: submits never wait — they fail fast to 503 for backpressure.
+    failover_wait_s: float = 60.0
     #: graceful-drain window on shutdown (SIGTERM → finish outstanding)
     drain_timeout_s: float = 30.0
     #: metrics pump: emit monitor Events every this many seconds
     metrics_interval_s: float = 2.0
+
+    # -- fault isolation (out-of-process replica workers) ---------------
+    #: "inprocess": replicas are engine threads sharing one param pytree
+    #: (fast, one XLA runtime — a replica crash kills the host process).
+    #: "subprocess": each replica is a worker process with its OWN XLA
+    #: runtime (``serving/worker.py``) — a segfault/OOM/hang is contained
+    #: to one replica and the supervisor respawns it.
+    replica_transport: str = "inprocess"
+    #: worker → pool heartbeat period (carries live stats)
+    heartbeat_interval_s: float = 0.25
+    #: no heartbeat for this long → the worker is declared down
+    #: (missed-beat detection; socket EOF is detected immediately)
+    heartbeat_timeout_s: float = 5.0
+    #: heartbeats flowing but the engine loop has not progressed for this
+    #: long WHILE work is outstanding → the worker is wedged (hung-replica
+    #: detection). Must exceed the worst-case first-request compile time.
+    hung_replica_timeout_s: float = 120.0
+    #: worker spawn → ready (socket up, first heartbeat) budget; a worker
+    #: pays its own JAX import + engine compile inside this window
+    spawn_timeout_s: float = 180.0
+    #: submit → worker ack budget (the ack is queue admission, not decode)
+    submit_timeout_s: float = 30.0
+    #: supervisor poll period
+    supervise_interval_s: float = 0.1
+    #: respawn backoff: exponential in the consecutive-failure count,
+    #: capped — base * 2**(fails-1), at most respawn_backoff_max_s
+    respawn_backoff_s: float = 0.5
+    respawn_backoff_max_s: float = 30.0
+    #: consecutive spawn/crash failures before the circuit breaker opens
+    #: and the slot stops respawning (the pool keeps serving at reduced
+    #: capacity on the surviving replicas)
+    circuit_breaker_threshold: int = 3
+    #: a worker that stays healthy this long resets its crash streak
+    respawn_reset_s: float = 5.0

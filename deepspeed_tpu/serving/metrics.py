@@ -122,6 +122,12 @@ class ServingMetrics:
         self.kv_utilization = 0.0
         # per-replica labeled series for /metrics (set by the pool pump)
         self.replica_stats: List[Dict[str, float]] = []
+        # fleet lifecycle counters (subprocess transport + supervisor):
+        # spawns/respawns/deaths/detections — the robustness ledger
+        self.fleet: Dict[str, int] = {
+            "spawns": 0, "respawns": 0, "worker_deaths": 0,
+            "heartbeat_misses": 0, "hung_detected": 0, "circuit_opens": 0,
+        }
         # prefix-cache mirror (engine-owned counters, summed over replicas
         # by the pump; all zero when the cache is disabled)
         self.prefix: Dict[str, float] = {
@@ -171,6 +177,13 @@ class ServingMetrics:
     def record_failover(self) -> None:
         with self._lock:
             self.failovers += 1
+
+    def record_fleet(self, key: str, n: int = 1) -> None:
+        """Replica lifecycle counter (transport + supervisor): one of
+        ``spawns``, ``respawns``, ``worker_deaths``, ``heartbeat_misses``,
+        ``hung_detected``, ``circuit_opens``."""
+        with self._lock:
+            self.fleet[key] = self.fleet.get(key, 0) + n
 
     def record_finish(self, reason: str, within_deadline: bool = True) -> None:
         """Terminal disposition.  ``within_deadline`` is the broker's
@@ -252,6 +265,8 @@ class ServingMetrics:
                 out[f"prefix_{k}"] = float(v)
             for k, v in self.spec.items():
                 out[f"spec_{k}"] = float(v)
+            for k, v in self.fleet.items():
+                out[f"replica_{k}"] = float(v)
             return out
 
     def to_events(self, step: int) -> List[Event]:
@@ -313,6 +328,22 @@ class ServingMetrics:
             b.gauge(f"{pre}spec_{k}",
                     f"Speculative decoding: {k.replace('_', ' ')}.",
                     snap[f"spec_{k}"])
+        _FLEET_HELP = {
+            "spawns": "Replica worker processes spawned (first generations).",
+            "respawns": "Replica worker processes respawned after a death.",
+            "worker_deaths": "Replica worker deaths (crash, exit, EOF, "
+                             "dead broker).",
+            "heartbeat_misses": "Replicas declared down by heartbeat "
+                                "timeout.",
+            "hung_detected": "Replicas declared down as hung (busy with "
+                             "stale progress).",
+            "circuit_opens": "Replica slots retired by the crash-loop "
+                             "circuit breaker.",
+        }
+        for k in self.fleet:
+            b.counter(f"{pre}replica_{k}",
+                      _FLEET_HELP.get(k, f"Fleet: {k.replace('_', ' ')}."),
+                      snap[f"replica_{k}"])
         if replica_stats:
             keys = [k for k in replica_stats[0] if k != "name"]
             for k in keys:

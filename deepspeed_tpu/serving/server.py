@@ -325,8 +325,11 @@ def create_server(pool: ReplicaPool, metrics: ServingMetrics,
 # -- deployment entrypoint -------------------------------------------------
 
 
-def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
-                                         ServingConfig]:
+def build_engine_factory(args) -> Callable[[], "object"]:
+    """Engine factory from parsed engine CLI args (``add_engine_cli_args``).
+    Shared by the HTTP front's in-process pool and the out-of-process
+    replica worker (``serving/worker.py``) so both transports build
+    bit-identical engines from the same flag set."""
     import jax
 
     from ..inference.v2.engine import InferenceEngineV2, V2Config
@@ -367,41 +370,87 @@ def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
         data = greedy_rollouts(params, model_cfg, prompts, args.spec_k + 10)
         spec_heads, _ = train_spec_heads(params, spec_heads, model_cfg, data,
                                          steps=args.spec_train_steps)
+    return lambda: InferenceEngineV2(model_cfg, params, v2,
+                                     draft_params=draft_params,
+                                     draft_config=draft_cfg,
+                                     spec_heads=spec_heads)
+
+
+def engine_argv_from_args(args) -> List[str]:
+    """Re-serialize the engine flag set for a worker subprocess: the worker
+    re-initializes the same params from the same seed, so every replica
+    process is token-identical to an in-process one under greedy decode."""
+    argv = ["--model", args.model, "--dtype", args.dtype,
+            "--seed", str(args.seed),
+            "--max_tokens_per_step", str(args.max_tokens_per_step),
+            "--max_seqs", str(args.max_seqs),
+            "--block_size", str(args.block_size),
+            "--num_blocks", str(args.num_blocks),
+            "--max_blocks_per_seq", str(args.max_blocks_per_seq),
+            "--prefix_eviction", args.prefix_eviction,
+            "--prefix_cache_min_tokens", str(args.prefix_cache_min_tokens),
+            "--spec_mode", args.spec_mode, "--spec_k", str(args.spec_k),
+            "--spec_train_steps", str(args.spec_train_steps)]
+    if args.enable_prefix_cache:
+        argv.append("--enable_prefix_cache")
+    if args.spec_draft_model:
+        argv += ["--spec_draft_model", args.spec_draft_model]
+    if args.spec_draft_seed is not None:
+        argv += ["--spec_draft_seed", str(args.spec_draft_seed)]
+    return argv
+
+
+def serving_argv_from_config(cfg: ServingConfig) -> List[str]:
+    """Worker-side serving knobs (queue cap, sampling, SLO) as CLI flags."""
+    argv = ["--max_queue", str(cfg.max_queue),
+            "--default_max_tokens", str(cfg.default_max_tokens),
+            "--temperature", str(cfg.temperature),
+            "--idle_wait_s", str(cfg.idle_wait_s)]
+    if cfg.deadline_s is not None:
+        argv += ["--deadline_s", str(cfg.deadline_s)]
+    if cfg.stop_token_ids:
+        argv += ["--stop_token_ids",
+                 ",".join(str(t) for t in cfg.stop_token_ids)]
+    return argv
+
+
+def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
+                                         ServingConfig]:
+    stop_ids = tuple(int(t) for t in args.stop_token_ids.split(",")) \
+        if args.stop_token_ids else ()
     cfg = ServingConfig(max_queue=args.max_queue,
                         default_max_tokens=args.default_max_tokens,
                         temperature=args.temperature,
                         deadline_s=args.deadline_s,
-                        num_replicas=args.replicas)
+                        stop_token_ids=stop_ids,
+                        idle_wait_s=args.idle_wait_s,
+                        num_replicas=args.replicas,
+                        replica_transport=args.replica_transport)
     monitor = None
     if args.csv_dir:
         from ..monitor.monitor import CSVMonitor
 
         monitor = CSVMonitor(args.csv_dir, job_name="serving")
     metrics = ServingMetrics()
-    pool = ReplicaPool.build(
-        lambda: InferenceEngineV2(model_cfg, params, v2,
-                                  draft_params=draft_params,
-                                  draft_config=draft_cfg,
-                                  spec_heads=spec_heads),
-        cfg, metrics=metrics, monitor=monitor)
+    if args.replica_transport == "subprocess":
+        worker_argv = (engine_argv_from_args(args)
+                       + serving_argv_from_config(cfg))
+        pool = ReplicaPool.build_subprocess(worker_argv, cfg,
+                                            metrics=metrics, monitor=monitor)
+    else:
+        pool = ReplicaPool.build(build_engine_factory(args), cfg,
+                                 metrics=metrics, monitor=monitor)
     return pool, metrics, cfg
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    import argparse
-
-    p = argparse.ArgumentParser(prog="dstpu-serve",
-                                description="deepspeed_tpu serving front")
+def add_engine_cli_args(p) -> None:
+    """Engine flags shared by the HTTP front (``dstpu-serve``) and the
+    out-of-process replica worker (``python -m deepspeed_tpu.serving.
+    worker``) — one flag set, one ``build_engine_factory``, so a worker
+    process builds the same engine the front would have built in-process."""
     p.add_argument("--model", default="tiny")
     p.add_argument("--dtype", default="float32")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, default=8000)
-    p.add_argument("--replicas", type=int, default=1)
-    p.add_argument("--max_queue", type=int, default=64)
-    p.add_argument("--default_max_tokens", type=int, default=64)
-    p.add_argument("--temperature", type=float, default=0.0)
-    p.add_argument("--deadline_s", type=float, default=None)
     p.add_argument("--max_tokens_per_step", type=int, default=64)
     p.add_argument("--max_seqs", type=int, default=8)
     p.add_argument("--block_size", type=int, default=16)
@@ -433,12 +482,42 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="self_draft: distill the speculation heads for this "
                         "many steps on the base model's greedy rollouts "
                         "before serving starts (0 = lm-head-seeded init)")
+
+
+def add_serving_cli_args(p) -> None:
+    """Admission / sampling knobs shared by the front and the worker."""
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--default_max_tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--deadline_s", type=float, default=None)
+    p.add_argument("--idle_wait_s", type=float, default=0.005)
+    p.add_argument("--stop_token_ids", default=None,
+                   help="comma-separated token ids that end generation")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="dstpu-serve",
+                                description="deepspeed_tpu serving front")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--replica_transport", choices=["inprocess", "subprocess"],
+                   default="inprocess",
+                   help="'subprocess' isolates each replica in its own "
+                        "process (own XLA runtime) behind the supervised "
+                        "transport — a replica crash/hang costs one worker, "
+                        "never the front")
+    add_engine_cli_args(p)
+    add_serving_cli_args(p)
     p.add_argument("--csv_dir", default=None,
                    help="emit serving metrics to a CSVMonitor at this path")
     args = p.parse_args(argv)
 
     pool, metrics, cfg = _build_pool_from_args(args)
     pool.start()
+    pool.wait_ready(timeout=cfg.spawn_timeout_s)
     server = create_server(pool, metrics, cfg, host=args.host, port=args.port,
                            model_name=args.model)
     stop = threading.Event()
@@ -475,10 +554,13 @@ def launch_server_subprocess(argv: Sequence[str], timeout_s: float = 120.0,
     prev = full_env.get("PYTHONPATH")
     full_env["PYTHONPATH"] = (pkg_root + os.pathsep + prev) if prev \
         else pkg_root
+    # new session: the front (and the replica workers it forks under
+    # --replica_transport subprocess) form one process group, so teardown
+    # can kill the whole tree with os.killpg — no orphaned workers
     proc = subprocess.Popen(
         [sys.executable, "-m", "deepspeed_tpu.serving.server", *argv],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=full_env)
+        env=full_env, start_new_session=True)
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         line = proc.stdout.readline()
@@ -489,15 +571,17 @@ def launch_server_subprocess(argv: Sequence[str], timeout_s: float = 120.0,
             continue
         if "dstpu-serving listening on " in line:
             return proc, line.split("listening on ", 1)[1].strip()
-    terminate_procs([proc], term_timeout_s=5.0)
+    terminate_procs([proc], term_timeout_s=5.0, process_group=True)
     raise TimeoutError("serving subprocess never became ready")
 
 
 def stop_server(proc: subprocess.Popen, term_timeout_s: float = 15.0) -> int:
     """Graceful stop: SIGTERM triggers the drain path; SIGKILL after the
     grace period (shared ``terminate_procs`` policy with the elastic
-    agent)."""
-    return terminate_procs([proc], term_timeout_s=term_timeout_s)[0]
+    agent).  Group-wide, so replica worker processes can't outlive the
+    front."""
+    return terminate_procs([proc], term_timeout_s=term_timeout_s,
+                           process_group=True)[0]
 
 
 if __name__ == "__main__":

@@ -1,0 +1,175 @@
+"""Replica supervisor: detect dead / hung workers, respawn with backoff.
+
+The control loop over :class:`~deepspeed_tpu.serving.transport.
+SubprocessReplica` slots, structurally the serving-side sibling of the
+elastic agent's generation loop (``elasticity/elastic_agent.py``): watch,
+declare failure, restart, and stop restarting when restarts stop helping.
+
+Detection hierarchy, cheapest signal first (each tick, per replica):
+
+1. **socket EOF** — handled by the transport's reader thread the instant
+   the worker dies; the supervisor only sees the aftermath (``down``).
+2. **process exit** without EOF (shouldn't happen; belt and braces).
+3. **missed beats** — no heartbeat for ``heartbeat_timeout_s``: the
+   worker process is alive but its heartbeat thread is not (e.g. the
+   ``serving.worker.hang`` chaos site), or the host is so wedged that
+   nothing runs.  Either way the replica is useless: declare it down.
+4. **hung replica** — beats still flowing but the engine loop has not
+   stamped progress for ``hung_replica_timeout_s`` WHILE work is
+   outstanding (``busy``): a stuck compile / wedged device
+   (``serving.step`` hang site).  Idle replicas never trip this.
+5. **dead broker** — the worker reports its own engine thread died
+   (``broker_healthy`` false in the heartbeat): the process is fine but
+   the replica can't serve; recycle it.
+
+Declaring down fails the in-flight streams with ``replica_dead`` → the
+balancer resubmits on a surviving replica, skipping the delivered prefix
+(token-identical under greedy decode).
+
+Respawn policy: exponential backoff ``min(respawn_backoff_max_s,
+respawn_backoff_s * 2**(fails-1))`` in the consecutive-failure count; a
+worker that stays healthy ``respawn_reset_s`` clears its streak.  At
+``circuit_breaker_threshold`` consecutive failures the slot's breaker
+opens and it stops respawning — a persistently crashing worker (bad
+model flags, poisoned host, persistent ``DSTPU_FAULTS``) must not burn
+the fleet's capacity on spawn loops.  The pool keeps serving on the
+survivors (graceful degradation); ``kv_utilization`` across healthy
+replicas is the live-capacity signal.
+
+Every transition lands in the tracer, the flight recorder, and the
+``dstpu_serving_replica_*`` fleet counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from ..observability.recorder import recorder
+from ..observability.trace import tracer
+from ..utils.logging import logger
+from .config import ServingConfig
+from .metrics import ServingMetrics
+from .transport import SubprocessReplica
+
+
+class ReplicaSupervisor:
+    """Health-check + respawn loop over subprocess replica slots."""
+
+    def __init__(self, replicas: Sequence[SubprocessReplica],
+                 config: ServingConfig,
+                 metrics: Optional[ServingMetrics] = None):
+        self.replicas: List[SubprocessReplica] = list(replicas)
+        self.cfg = config
+        self.metrics = metrics
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "ReplicaSupervisor":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run,
+                                        name="dstpu-supervisor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.supervise_interval_s):
+            for r in self.replicas:
+                try:
+                    self._tick(r)
+                except Exception as e:  # noqa: BLE001 — one bad slot must
+                    # not stop supervision of the others
+                    logger.error(f"supervisor: tick failed for {r.name}: "
+                                 f"{e!r}")
+
+    # -- per-replica state machine ---------------------------------------
+
+    def _tick(self, r: SubprocessReplica) -> None:
+        live = r.liveness()
+        if live["stopping"]:
+            return
+        if live["down"] is None:
+            self._check_health(r, live)
+        else:
+            self._maybe_respawn(r)
+
+    def _check_health(self, r: SubprocessReplica, live: dict) -> None:
+        if not live["connected"]:
+            return  # still spawning; the connector enforces spawn_timeout_s
+        if not live["alive"]:
+            self._declare(r, "worker_exited", "worker_deaths")
+        elif live["hb_age"] > self.cfg.heartbeat_timeout_s:
+            self._declare(r, "heartbeat_timeout", "heartbeat_misses",
+                          hb_age=round(live["hb_age"], 3))
+        elif live["busy"] and \
+                live["progress_age"] > self.cfg.hung_replica_timeout_s:
+            self._declare(r, "hung_replica", "hung_detected",
+                          progress_age=round(live["progress_age"], 3))
+        elif not live["broker_healthy"]:
+            self._declare(r, "broker_dead", "worker_deaths")
+        elif r.consecutive_failures and \
+                live["spawn_age"] > self.cfg.respawn_reset_s:
+            logger.info(f"supervisor: {r.name} healthy for "
+                        f"{live['spawn_age']:.1f}s — crash streak "
+                        f"({r.consecutive_failures}) cleared")
+            r.consecutive_failures = 0
+
+    def _declare(self, r: SubprocessReplica, reason: str, counter: str,
+                 **attrs) -> None:
+        logger.warning(f"supervisor: declaring {r.name} gen {r.generation} "
+                       f"down: {reason} {attrs or ''}")
+        if self.metrics is not None:
+            self.metrics.record_fleet(counter)
+        tracer.add_event(f"replica/{reason}",
+                         attrs={"replica": r.name,
+                                "generation": r.generation, **attrs})
+        r.mark_down(reason)
+
+    def _maybe_respawn(self, r: SubprocessReplica) -> None:
+        if r.circuit_open:
+            return
+        now = time.monotonic()
+        if r.next_respawn_at == 0.0:
+            # fresh death: count it, then either open the breaker or
+            # schedule the next generation
+            r.consecutive_failures += 1
+            if r.consecutive_failures >= self.cfg.circuit_breaker_threshold:
+                r.circuit_open = True
+                logger.error(
+                    f"supervisor: circuit breaker OPEN for {r.name} after "
+                    f"{r.consecutive_failures} consecutive failures — slot "
+                    "retired; pool degrades to surviving replicas")
+                if self.metrics is not None:
+                    self.metrics.record_fleet("circuit_opens")
+                tracer.add_event("replica/circuit_open",
+                                 attrs={"replica": r.name,
+                                        "failures": r.consecutive_failures})
+                recorder.record_event("replica/circuit_open",
+                                      replica=r.name,
+                                      failures=r.consecutive_failures)
+                return
+            backoff = min(
+                self.cfg.respawn_backoff_max_s,
+                self.cfg.respawn_backoff_s
+                * (2 ** (r.consecutive_failures - 1)))
+            r.next_respawn_at = now + backoff
+            logger.info(f"supervisor: respawning {r.name} in {backoff:.2f}s "
+                        f"(failure #{r.consecutive_failures})")
+            tracer.add_event("replica/respawn_scheduled",
+                             attrs={"replica": r.name,
+                                    "backoff_s": round(backoff, 3),
+                                    "failures": r.consecutive_failures})
+            return
+        if now >= r.next_respawn_at:
+            r.next_respawn_at = 0.0
+            r.respawn()

@@ -1,0 +1,665 @@
+"""Replica transports: how the pool reaches a replica.
+
+Capability analogue of DeepSpeed-MII's replica fan-out
+(``mii/grpc_related/``): the reference load balancer fronts replica
+**processes** over gRPC.  This module puts the same seam into our pool:
+:class:`ReplicaPool` routes over :class:`ReplicaTransport` objects and
+never touches an engine directly, so the same least-outstanding-tokens
+routing and delivered-prefix failover drive both implementations:
+
+* :class:`InProcessReplica` — the original arrangement: a
+  :class:`~deepspeed_tpu.serving.broker.RequestBroker` engine thread in
+  this process, sharing one param pytree with its siblings.  Fast, zero
+  copies — and zero fault isolation: one XLA crash kills every replica.
+* :class:`SubprocessReplica` — a worker **process**
+  (``python -m deepspeed_tpu.serving.worker``, spawned with
+  ``start_new_session=True`` so teardown can ``os.killpg`` the whole
+  group) that owns its own engine and its own XLA runtime, reached over a
+  local TCP socket with a length-prefixed JSON protocol.  A replica
+  segfault, OOM, or hang is contained to that process; the supervisor
+  (``serving/supervisor.py``) detects it by heartbeat and respawns it.
+
+Wire protocol (4-byte big-endian length + UTF-8 JSON, both directions):
+
+* pool → worker: ``{"op": "submit", "rid", "prompt", ...}``,
+  ``{"op": "cancel", "rid"}``, ``{"op": "fault", "spec"}`` (chaos hook:
+  arm ``utils/faults`` sites inside the worker), ``{"op": "stop"}``.
+* worker → pool: ``{"ev": "hb", "stats"}`` heartbeats (liveness + the
+  stats the pool's routing and gauges need), ``accepted``/``rejected``
+  submit acks, ``tok``/``done``/``err`` per-request stream frames.
+
+A dead worker fails its in-flight streams with ``replica_dead``; the
+balancer resubmits on a surviving replica and skips the tokens the client
+already received — token-identical under greedy decode, exactly the
+in-process failover path.
+"""
+
+from __future__ import annotations
+
+import abc
+import itertools
+import json
+import os
+import queue
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from ..observability.recorder import recorder
+from ..observability.trace import tracer
+from ..utils.logging import logger
+from ..utils.proc import terminate_procs
+from .broker import (BrokerStoppedError, InvalidRequestError, QueueFullError,
+                     RequestBroker, RequestFailedError)
+from .config import ServingConfig
+from .metrics import ServingMetrics
+
+READY_MARKER = "dstpu-worker listening on "
+
+_LEN = struct.Struct(">I")
+#: sanity cap on a single frame (a corrupt length prefix must not OOM us)
+MAX_FRAME = 32 * 1024 * 1024
+
+
+def send_frame(sock: socket.socket, obj: Dict[str, Any],
+               lock: Optional[threading.Lock] = None) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    data = _LEN.pack(len(payload)) + payload
+    if lock is not None:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+
+
+def recv_frame(rfile) -> Optional[Dict[str, Any]]:
+    """Read one frame from a buffered socket file; None on clean EOF."""
+    header = rfile.read(_LEN.size)
+    if not header:
+        return None
+    if len(header) < _LEN.size:
+        raise ConnectionError("truncated frame header")
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ConnectionError(f"frame of {n} bytes exceeds cap {MAX_FRAME}")
+    payload = rfile.read(n)
+    if len(payload) < n:
+        raise ConnectionError("truncated frame payload")
+    return json.loads(payload)
+
+
+class ReplicaTransport(abc.ABC):
+    """What the pool needs from a replica, wherever it runs.  All stats
+    accessors must be non-blocking and must not raise on a dead replica —
+    the pool's health endpoint and metrics pump call them unconditionally."""
+
+    name: str
+
+    @abc.abstractmethod
+    def start(self) -> "ReplicaTransport": ...
+
+    @abc.abstractmethod
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None: ...
+
+    @abc.abstractmethod
+    def kill(self, reason: str = "replica_dead") -> None: ...
+
+    @abc.abstractmethod
+    def healthy(self) -> bool: ...
+
+    @abc.abstractmethod
+    def submit(self, **kwargs): ...
+
+    @abc.abstractmethod
+    def cancel(self, rid: str) -> bool: ...
+
+    @abc.abstractmethod
+    def queue_depth(self) -> int: ...
+
+    @abc.abstractmethod
+    def outstanding_tokens(self) -> int: ...
+
+    @abc.abstractmethod
+    def kv_utilization(self) -> float: ...
+
+    @abc.abstractmethod
+    def num_running(self) -> int: ...
+
+    @abc.abstractmethod
+    def prefix_stats(self) -> Dict[str, float]: ...
+
+    @abc.abstractmethod
+    def spec_stats(self) -> Dict[str, float]: ...
+
+    def describe(self) -> Dict[str, Any]:
+        """Transport-specific health extras (process ids, generations)."""
+        return {}
+
+
+class InProcessReplica(ReplicaTransport):
+    """The pre-fleet arrangement behind the transport seam: an engine
+    thread in this process.  Keeps the zero-copy param sharing (and the
+    shared fate: no fault isolation)."""
+
+    transport = "inprocess"
+
+    def __init__(self, broker: RequestBroker):
+        self.broker = broker
+        self.name = broker.name
+
+    # the serving tests and bench reach through to the engine for leak /
+    # block-accounting assertions; only this transport can offer that
+    @property
+    def engine(self):
+        return self.broker.engine
+
+    def start(self) -> "InProcessReplica":
+        self.broker.start()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        self.broker.stop(drain=drain, timeout=timeout)
+
+    def kill(self, reason: str = "replica_dead") -> None:
+        self.broker.kill(reason)
+
+    def healthy(self) -> bool:
+        return self.broker.healthy()
+
+    def submit(self, **kwargs):
+        return self.broker.submit(**kwargs)
+
+    def cancel(self, rid: str) -> bool:
+        return self.broker.cancel(rid)
+
+    def queue_depth(self) -> int:
+        return self.broker.queue_depth()
+
+    def outstanding_tokens(self) -> int:
+        return self.broker.outstanding_tokens()
+
+    def kv_utilization(self) -> float:
+        return self.broker.kv_utilization()
+
+    def num_running(self) -> int:
+        return self.broker.engine.num_running
+
+    def prefix_stats(self) -> Dict[str, float]:
+        return self.broker.engine.prefix_stats()
+
+    def spec_stats(self) -> Dict[str, float]:
+        return self.broker.engine.spec_stats()
+
+
+class RemoteHandle:
+    """Client-side view of a request running in a worker process — same
+    surface as :class:`~deepspeed_tpu.serving.broker.RequestHandle`, fed
+    by the transport's reader thread demultiplexing stream frames."""
+
+    def __init__(self, transport: "SubprocessReplica", rid: str,
+                 prompt: List[int]):
+        self._transport = transport
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.finish_reason: Optional[str] = None
+        self.q: "queue.Queue" = queue.Queue()
+
+    def cancel(self) -> None:
+        self._transport.cancel(self.rid)
+
+    def tokens(self, timeout: Optional[float] = None) -> Iterator[int]:
+        while True:
+            kind, payload = self.q.get(timeout=timeout)
+            if kind == "tok":
+                yield payload
+            elif kind == "done":
+                self.finish_reason = payload
+                return
+            else:  # "err"
+                self.finish_reason = payload[0]
+                raise RequestFailedError(payload[0], payload[1])
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        return list(self.tokens(timeout=timeout))
+
+
+class SubprocessReplica(ReplicaTransport):
+    """A replica living in its own process (its own XLA runtime), reached
+    over the length-prefixed socket protocol.  Restartable: after a death
+    the supervisor calls :meth:`respawn` and the same object serves the
+    next worker generation (the pool's routing indexes stay stable).
+
+    ``worker_argv`` is the ``python -m deepspeed_tpu.serving.worker``
+    argument list describing the engine (model, geometry, caching/spec
+    flags); ``extra_env`` is merged into the worker environment on every
+    (re)spawn — chaos tests use it to arm persistent ``DSTPU_FAULTS``."""
+
+    transport = "subprocess"
+
+    def __init__(self, worker_argv: Sequence[str], config: ServingConfig,
+                 name: str = "replica0",
+                 metrics: Optional[ServingMetrics] = None,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.worker_argv = list(worker_argv)
+        self.cfg = config
+        self.name = name
+        self.metrics = metrics
+        self.extra_env = dict(extra_env or {})
+        self._lock = threading.Lock()
+        self._wlock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._pending: Dict[str, RemoteHandle] = {}
+        self._acks: Dict[str, "queue.Queue"] = {}
+        self._stats: Dict[str, Any] = {}
+        self._connected = threading.Event()
+        self._down: Optional[str] = None
+        self._stopping = False
+        self._last_hb = 0.0
+        self._rid_counter = itertools.count(1)
+        # supervisor bookkeeping (serving/supervisor.py)
+        self.generation = 0
+        self.spawn_ts = 0.0
+        self.consecutive_failures = 0
+        self.circuit_open = False
+        self.next_respawn_at = 0.0
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "SubprocessReplica":
+        """Spawn the worker and return immediately; a connector thread
+        waits for the ready line and wires the socket.  ``healthy()``
+        flips true once connected (use ``ReplicaPool.wait_ready``)."""
+        with self._lock:
+            if self._proc is not None and self._down is None:
+                return self
+            self._down = None
+            self._stopping = False
+            self._connected.clear()
+            self._pending = {}
+            self._acks = {}
+            self._stats = {}
+            self.spawn_ts = time.monotonic()
+        env = dict(os.environ)
+        # the worker must import deepspeed_tpu regardless of caller cwd
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        prev = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + prev) if prev \
+            else pkg_root
+        env.update(self.extra_env)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "deepspeed_tpu.serving.worker",
+             "--name", f"{self.name}.g{self.generation}",
+             "--heartbeat_interval_s", str(self.cfg.heartbeat_interval_s),
+             *self.worker_argv],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, start_new_session=True)
+        with self._lock:
+            self._proc = proc
+        logger.info(f"serving transport: spawned worker {self.name} "
+                    f"gen {self.generation} pid {proc.pid}")
+        tracer.add_event("replica/spawn",
+                         attrs={"replica": self.name, "pid": proc.pid,
+                                "generation": self.generation})
+        recorder.record_event("replica/spawn", replica=self.name,
+                              pid=proc.pid, generation=self.generation)
+        if self.metrics is not None:
+            self.metrics.record_fleet(
+                "respawns" if self.generation else "spawns")
+        threading.Thread(target=self._connector, args=(proc,),
+                         name=f"dstpu-connect-{self.name}",
+                         daemon=True).start()
+        return self
+
+    def respawn(self) -> "SubprocessReplica":
+        """Next worker generation after a death (supervisor-driven)."""
+        with self._lock:
+            self.generation += 1
+            self._proc = None  # previous generation already reaped
+        return self.start()
+
+    def _connector(self, proc: subprocess.Popen) -> None:
+        """Wait for the worker's ready line, connect, then keep draining
+        worker stdout (its logs) so the pipe can never fill and block it."""
+        deadline = self.spawn_ts + self.cfg.spawn_timeout_s
+        addr = None
+        try:
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    rc = proc.poll()
+                    raise RuntimeError(f"worker exited rc={rc} before ready")
+                if READY_MARKER in line:
+                    addr = line.split(READY_MARKER, 1)[1].strip()
+                    break
+                logger.debug(f"worker[{self.name}]: {line.rstrip()}")
+            if addr is None:
+                raise TimeoutError(
+                    f"worker not ready in {self.cfg.spawn_timeout_s:.0f}s")
+            host, port = addr.rsplit(":", 1)
+            sock = socket.create_connection((host, int(port)), timeout=30.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                if self._down is not None or proc is not self._proc:
+                    sock.close()
+                    return
+                self._sock = sock
+                self._rfile = sock.makefile("rb")
+                self._last_hb = time.monotonic()
+            self._connected.set()
+            threading.Thread(target=self._reader, args=(proc,),
+                             name=f"dstpu-reader-{self.name}",
+                             daemon=True).start()
+        except Exception as e:
+            logger.error(f"serving transport: worker {self.name} spawn "
+                         f"failed: {e!r}")
+            self._declare_down(f"spawn_failed: {e}", from_spawn=True)
+            return
+        # stdout drain (post-ready): worker logs route to our logger
+        try:
+            for line in proc.stdout:
+                logger.debug(f"worker[{self.name}]: {line.rstrip()}")
+        except (OSError, ValueError):
+            pass
+
+    def _reader(self, proc: subprocess.Popen) -> None:
+        rfile = self._rfile
+        try:
+            while True:
+                frame = recv_frame(rfile)
+                if frame is None:
+                    raise ConnectionError("worker closed the socket")
+                self._dispatch(frame)
+        except (ConnectionError, OSError, ValueError, json.JSONDecodeError) \
+                as e:
+            with self._lock:
+                deliberate = self._stopping or proc is not self._proc
+            if not deliberate:
+                self._declare_down("replica_dead")
+                logger.warning(f"serving transport: worker {self.name} "
+                               f"connection lost: {e!r}")
+
+    def _dispatch(self, frame: Dict[str, Any]) -> None:
+        ev = frame.get("ev")
+        if ev == "hb":
+            with self._lock:
+                self._last_hb = time.monotonic()
+                self._stats = frame.get("stats", {})
+            return
+        rid = frame.get("rid")
+        if ev in ("accepted", "rejected"):
+            with self._lock:
+                ack_q = self._acks.get(rid)
+            if ack_q is not None:
+                ack_q.put(frame)
+            return
+        with self._lock:
+            handle = self._pending.get(rid)
+        if handle is None:
+            return  # cancelled/failed-over request still streaming: drop
+        if ev == "tok":
+            for tok in frame["toks"]:
+                handle.q.put(("tok", tok))
+        elif ev == "done":
+            with self._lock:
+                self._pending.pop(rid, None)
+            handle.q.put(("done", frame.get("reason")))
+        elif ev == "err":
+            with self._lock:
+                self._pending.pop(rid, None)
+            handle.q.put(("err", (frame.get("reason", "engine_error"),
+                                  frame.get("detail", ""))))
+
+    def _declare_down(self, reason: str, from_spawn: bool = False) -> None:
+        """Idempotent death transition: fail in-flight streams (the
+        balancer fails them over), tear the process group down, leave a
+        flight-recorder dump."""
+        with self._lock:
+            if self._down is not None or self._stopping:
+                return
+            self._down = reason
+            pending = list(self._pending.values())
+            acks = list(self._acks.values())
+            self._pending = {}
+            self._acks = {}
+            proc = self._proc
+            sock, self._sock = self._sock, None
+            rfile, self._rfile = self._rfile, None
+        for ack_q in acks:
+            ack_q.put({"ev": "rejected", "etype": "stopped",
+                       "detail": reason})
+        for h in pending:
+            h.q.put(("err", ("replica_dead", reason)))
+        self._close_io(sock, rfile)
+        if proc is not None:
+            # the worker was started in its own session: reap the whole
+            # group so engine helper processes can't outlive it
+            terminate_procs([proc], term_timeout_s=2.0, process_group=True)
+            self._close_stdout(proc)
+        logger.error(f"serving transport: worker {self.name} gen "
+                     f"{self.generation} DOWN ({reason}); "
+                     f"{len(pending)} in-flight streams failing over")
+        tracer.add_event("replica/death",
+                         attrs={"replica": self.name, "reason": reason,
+                                "generation": self.generation,
+                                "in_flight": len(pending)})
+        recorder.record_event("replica/death", replica=self.name,
+                              reason=reason, generation=self.generation,
+                              in_flight=len(pending))
+        if self.metrics is not None:
+            self.metrics.record_fleet("worker_deaths")
+        if not from_spawn:
+            recorder.dump(reason=f"worker_death_{self.name}")
+
+    def kill(self, reason: str = "replica_dead") -> None:
+        """Hard-kill the worker process group (SIGKILL, no grace) — the
+        fault-injection-free way to simulate a worker crash."""
+        with self._lock:
+            proc = self._proc
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+        self._declare_down(reason)
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        timeout = 30.0 if timeout is None else timeout
+        with self._lock:
+            self._stopping = True
+            sock = self._sock
+            proc = self._proc
+        if sock is not None:
+            try:
+                send_frame(sock, {"op": "stop", "drain": drain,
+                                  "timeout": timeout}, self._wlock)
+            except OSError:
+                pass
+        if proc is not None:
+            deadline = time.monotonic() + timeout
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            terminate_procs([proc], term_timeout_s=5.0, process_group=True)
+            self._close_stdout(proc)
+        with self._lock:
+            sock, self._sock = self._sock, None
+            rfile, self._rfile = self._rfile, None
+            pending = list(self._pending.values())
+            self._pending = {}
+        for h in pending:
+            h.q.put(("err", ("shutdown", "replica stopped")))
+        self._close_io(sock, rfile)
+
+    @staticmethod
+    def _close_io(sock, rfile) -> None:
+        """Close the socket AND its buffered reader: ``makefile`` holds an
+        io-ref on the fd, so closing only the socket object would leave
+        the descriptor open until GC (the leak tests count fds)."""
+        for f in (rfile, sock):
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+
+    def _close_stdout(self, proc: subprocess.Popen) -> None:
+        """Release the worker's stdout pipe once it has exited (the
+        connector's drain loop tolerates the close)."""
+        if proc.stdout is not None:
+            try:
+                proc.stdout.close()
+            except OSError:
+                pass
+
+    # -- client surface --------------------------------------------------
+
+    def healthy(self) -> bool:
+        with self._lock:
+            proc = self._proc
+            return (self._down is None and not self._stopping
+                    and self.circuit_open is False
+                    and self._connected.is_set()
+                    and proc is not None and proc.poll() is None)
+
+    def submit(self, prompt: Sequence[int], rid: Optional[str] = None,
+               **kwargs):
+        if not self.healthy():
+            raise BrokerStoppedError(f"replica {self.name} not accepting")
+        rid = rid or f"{self.name}.g{self.generation}-{next(self._rid_counter)}"
+        handle = RemoteHandle(self, rid, list(prompt))
+        ack_q: "queue.Queue" = queue.Queue()
+        with self._lock:
+            if self._down is not None or self._stopping or self._sock is None:
+                raise BrokerStoppedError(f"replica {self.name} not accepting")
+            self._pending[rid] = handle
+            self._acks[rid] = ack_q
+            sock = self._sock
+        msg = {"op": "submit", "rid": rid, "prompt": list(prompt)}
+        for key in ("max_new_tokens", "temperature", "deadline_s",
+                    "stop_token_ids"):
+            if kwargs.get(key) is not None:
+                msg[key] = kwargs[key] if key != "stop_token_ids" \
+                    else list(kwargs[key])
+        try:
+            send_frame(sock, msg, self._wlock)
+            ack = ack_q.get(timeout=self.cfg.submit_timeout_s)
+        except (OSError, queue.Empty) as e:
+            with self._lock:
+                self._pending.pop(rid, None)
+                self._acks.pop(rid, None)
+            raise BrokerStoppedError(
+                f"replica {self.name} unreachable on submit: {e!r}")
+        finally:
+            with self._lock:
+                self._acks.pop(rid, None)
+        if ack.get("ev") == "accepted":
+            return handle
+        with self._lock:
+            self._pending.pop(rid, None)
+        etype = ack.get("etype")
+        detail = ack.get("detail", "")
+        if etype == "queue_full":
+            raise QueueFullError(detail or "admission queue full")
+        if etype == "invalid":
+            raise InvalidRequestError(detail or "invalid request")
+        raise BrokerStoppedError(detail or f"replica {self.name} rejected")
+
+    def cancel(self, rid: str) -> bool:
+        with self._lock:
+            sock = self._sock
+            known = rid in self._pending
+        if sock is None:
+            return False
+        try:
+            send_frame(sock, {"op": "cancel", "rid": rid}, self._wlock)
+        except OSError:
+            return False
+        return known
+
+    def inject_fault(self, spec: Dict[str, str]) -> bool:
+        """Arm ``utils/faults`` sites inside the CURRENT worker process
+        (chaos tests; respawned generations start clean — use
+        ``extra_env={"DSTPU_FAULTS": ...}`` for persistent faults)."""
+        with self._lock:
+            sock = self._sock
+        if sock is None:
+            return False
+        try:
+            send_frame(sock, {"op": "fault", "spec": dict(spec)},
+                       self._wlock)
+        except OSError:
+            return False
+        return True
+
+    # -- stats (heartbeat-carried; never raises on a dead worker) --------
+
+    def _stat(self, key: str, default=0):
+        with self._lock:
+            return self._stats.get(key, default)
+
+    def queue_depth(self) -> int:
+        return int(self._stat("queue_depth"))
+
+    def outstanding_tokens(self) -> int:
+        with self._lock:
+            base = int(self._stats.get("outstanding_tokens", 0))
+            n_pending = len(self._pending)
+        # heartbeat stats lag by up to one interval: count locally-known
+        # in-flight requests as a floor so burst routing still spreads
+        return max(base, n_pending)
+
+    def kv_utilization(self) -> float:
+        return float(self._stat("kv_utilization", 0.0))
+
+    def num_running(self) -> int:
+        return int(self._stat("running"))
+
+    def prefix_stats(self) -> Dict[str, float]:
+        return dict(self._stat("prefix", {}))
+
+    def spec_stats(self) -> Dict[str, float]:
+        return dict(self._stat("spec", {}))
+
+    # -- supervisor surface ----------------------------------------------
+
+    def liveness(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lock:
+            proc = self._proc
+            return {
+                "down": self._down,
+                "stopping": self._stopping,
+                "connected": self._connected.is_set(),
+                "alive": proc is not None and proc.poll() is None,
+                "pid": None if proc is None else proc.pid,
+                "hb_age": (now - self._last_hb) if self._last_hb else 0.0,
+                "progress_age": float(self._stats.get("progress_age", 0.0)),
+                "busy": bool(self._stats.get("busy", False)),
+                "broker_healthy": bool(self._stats.get("healthy", True)),
+                "spawn_age": now - self.spawn_ts,
+            }
+
+    def mark_down(self, reason: str) -> None:
+        """Supervisor verdict (heartbeat timeout / hung replica)."""
+        self._declare_down(reason)
+
+    def describe(self) -> Dict[str, Any]:
+        live = self.liveness()
+        return {"transport": self.transport, "pid": live["pid"],
+                "generation": self.generation,
+                "consecutive_failures": self.consecutive_failures,
+                "circuit_open": self.circuit_open,
+                "down_reason": live["down"]}

@@ -1,0 +1,237 @@
+"""Out-of-process replica worker: one engine, one process, one socket.
+
+The fault-isolation unit of the serving fleet
+(``--replica_transport subprocess``).  Each worker owns a full
+:class:`~deepspeed_tpu.inference.v2.engine.InferenceEngineV2` behind a
+:class:`~deepspeed_tpu.serving.broker.RequestBroker` — its own params,
+its own paged KV, its own XLA runtime — so a segfault, OOM, wedged
+compile, or injected chaos fault costs exactly one replica.  The pool
+side of the socket is :class:`~deepspeed_tpu.serving.transport.
+SubprocessReplica`; the supervisor respawns us as ``<name>.g<N+1>``.
+
+Startup handshake: bind ``127.0.0.1:<ephemeral>``, print
+``dstpu-worker listening on HOST:PORT`` (the parent greps for it), accept
+exactly one connection.  After that, three thread roles:
+
+* **main**: reader loop over ``submit`` / ``cancel`` / ``fault`` /
+  ``stop`` ops (frame format: ``serving/transport.py``).
+* **heartbeat**: every ``--heartbeat_interval_s``, one ``hb`` frame with
+  the stats the pool's routing, gauges, and hung-replica detection need.
+* **pump** (per request): forwards the broker's token stream as ``tok``
+  frames, then ``done`` / ``err``.
+
+Chaos sites (``utils/faults``), all reachable via the parent's
+``inject_fault`` protocol op or a persistent ``DSTPU_FAULTS`` env:
+
+* ``serving.worker.start`` — spawn-time crash (crash-loop / circuit-
+  breaker tests; fires before the engine builds, so loops are cheap);
+* ``serving.worker.hardkill`` — hard ``os._exit`` from the heartbeat
+  thread (mid-decode worker loss);
+* ``serving.worker.hang`` — the heartbeat thread sleeps forever: beats
+  stop while the process stays alive (missed-beat detection);
+* ``serving.worker.heartbeat`` — ``delay`` kind: slow heartbeats;
+* ``serving.step`` (in the broker loop) — ``hang`` kind wedges the
+  engine thread itself: beats keep flowing but ``progress_age`` grows
+  while ``busy`` (hung-replica detection).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import socket
+import sys
+import threading
+from typing import Optional
+
+from ..observability.recorder import recorder
+from ..utils import faults
+from ..utils.logging import logger
+from .broker import (BrokerStoppedError, InvalidRequestError, QueueFullError,
+                     RequestBroker, RequestFailedError)
+from .config import ServingConfig
+from .transport import READY_MARKER, recv_frame, send_frame
+
+
+def _stats(broker: RequestBroker) -> dict:
+    eng = broker.engine
+    return {
+        "healthy": broker.healthy(),
+        "busy": broker.busy(),
+        "progress_age": broker.progress_age(),
+        "queue_depth": broker.queue_depth(),
+        "outstanding_tokens": broker.outstanding_tokens(),
+        "kv_utilization": broker.kv_utilization(),
+        "running": eng.num_running,
+        "waiting": eng.num_waiting,
+        "prefix": eng.prefix_stats(),
+        "spec": eng.spec_stats(),
+    }
+
+
+def _pump(conn: socket.socket, wlock: threading.Lock, rid: str,
+          handle) -> None:
+    """Forward one request's token stream to the parent.  A send failure
+    means the parent is gone — cancel the request so it stops holding KV."""
+    try:
+        try:
+            for tok in handle.tokens():
+                send_frame(conn, {"ev": "tok", "rid": rid, "toks": [tok]},
+                           wlock)
+            send_frame(conn, {"ev": "done", "rid": rid,
+                              "reason": handle.finish_reason}, wlock)
+        except RequestFailedError as e:
+            send_frame(conn, {"ev": "err", "rid": rid, "reason": e.reason,
+                              "detail": str(e)}, wlock)
+    except OSError:
+        handle.cancel()
+
+
+def _heartbeat_loop(conn: socket.socket, wlock: threading.Lock,
+                    broker: RequestBroker, interval_s: float,
+                    stop_evt: threading.Event) -> None:
+    while not stop_evt.wait(interval_s):
+        faults.maybe_fail("serving.worker.hardkill")
+        faults.maybe_fail("serving.worker.hang")
+        faults.maybe_fail("serving.worker.heartbeat")
+        try:
+            send_frame(conn, {"ev": "hb", "stats": _stats(broker)}, wlock)
+        except OSError:
+            return  # parent gone; the reader loop handles shutdown
+
+
+def main(argv: Optional[list] = None) -> int:
+    from .server import add_engine_cli_args, add_serving_cli_args, \
+        build_engine_factory
+
+    p = argparse.ArgumentParser(
+        prog="dstpu-worker",
+        description="deepspeed_tpu out-of-process replica worker")
+    p.add_argument("--name", default="replica0.g0")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--heartbeat_interval_s", type=float, default=0.25)
+    add_engine_cli_args(p)
+    add_serving_cli_args(p)
+    args = p.parse_args(argv)
+
+    # chaos: spawn-time crash site — BEFORE the engine builds, so a
+    # crash-looping worker (persistent DSTPU_FAULTS) fails fast and the
+    # supervisor's circuit breaker sees a tight loop, not compile waits
+    faults.maybe_fail("serving.worker.start")
+    recorder.install_crash_hook()  # injected hard-kills leave a dump
+
+    scfg = ServingConfig(
+        max_queue=args.max_queue,
+        default_max_tokens=args.default_max_tokens,
+        temperature=args.temperature,
+        deadline_s=args.deadline_s,
+        stop_token_ids=tuple(int(t) for t in args.stop_token_ids.split(","))
+        if args.stop_token_ids else (),
+        idle_wait_s=args.idle_wait_s,
+        num_replicas=1,
+        heartbeat_interval_s=args.heartbeat_interval_s)
+    logger.info(f"worker {args.name}: building engine (model={args.model})")
+    broker = RequestBroker(build_engine_factory(args)(), scfg,
+                           name=args.name)
+    broker.start()
+
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.bind((args.host, 0))
+    lsock.listen(1)
+    lsock.settimeout(300.0)
+    host, port = lsock.getsockname()
+    # the parent transport greps worker stdout for this line
+    print(f"{READY_MARKER}{host}:{port}", flush=True)
+    try:
+        conn, _ = lsock.accept()
+    except socket.timeout:
+        logger.error(f"worker {args.name}: parent never connected")
+        broker.stop(drain=False, timeout=5.0)
+        return 1
+    finally:
+        lsock.close()
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    rfile = conn.makefile("rb")
+    wlock = threading.Lock()
+    stop_evt = threading.Event()
+    drain_on_stop = {"drain": False, "timeout": 5.0}
+
+    def _sigterm(signum, frame):
+        # group-wide teardown (os.killpg from the parent): unblock the
+        # reader by shutting the read side down; teardown runs below
+        stop_evt.set()
+        try:
+            conn.shutdown(socket.SHUT_RD)
+        except OSError:
+            pass
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    threading.Thread(
+        target=_heartbeat_loop,
+        args=(conn, wlock, broker, args.heartbeat_interval_s, stop_evt),
+        name="dstpu-worker-hb", daemon=True).start()
+    logger.info(f"worker {args.name}: serving on {host}:{port}")
+
+    while not stop_evt.is_set():
+        try:
+            frame = recv_frame(rfile)
+        except (ConnectionError, OSError):
+            frame = None
+        if frame is None:
+            break  # parent closed (or died): exit; the group reaper
+            # would get us anyway, but exiting frees the engine now
+        op = frame.get("op")
+        if op == "submit":
+            rid = frame["rid"]
+            try:
+                handle = broker.submit(
+                    prompt=frame["prompt"],
+                    max_new_tokens=frame.get("max_new_tokens"),
+                    temperature=frame.get("temperature"),
+                    deadline_s=frame.get("deadline_s"),
+                    stop_token_ids=frame.get("stop_token_ids", ()),
+                    rid=rid)
+            except QueueFullError as e:
+                send_frame(conn, {"ev": "rejected", "rid": rid,
+                                  "etype": "queue_full", "detail": str(e)},
+                           wlock)
+            except InvalidRequestError as e:
+                send_frame(conn, {"ev": "rejected", "rid": rid,
+                                  "etype": "invalid", "detail": str(e)},
+                           wlock)
+            except BrokerStoppedError as e:
+                send_frame(conn, {"ev": "rejected", "rid": rid,
+                                  "etype": "stopped", "detail": str(e)},
+                           wlock)
+            else:
+                send_frame(conn, {"ev": "accepted", "rid": rid}, wlock)
+                threading.Thread(target=_pump,
+                                 args=(conn, wlock, rid, handle),
+                                 name=f"dstpu-pump-{rid}",
+                                 daemon=True).start()
+        elif op == "cancel":
+            broker.cancel(frame.get("rid", ""))
+        elif op == "fault":
+            # chaos hook: arm fault sites inside THIS worker generation
+            spec = frame.get("spec") or {}
+            logger.warning(f"worker {args.name}: arming faults {spec}")
+            faults.configure(spec)
+        elif op == "stop":
+            drain_on_stop = {"drain": bool(frame.get("drain", True)),
+                             "timeout": frame.get("timeout", 30.0)}
+            break
+        else:
+            logger.warning(f"worker {args.name}: unknown op {op!r}")
+
+    stop_evt.set()
+    broker.stop(**drain_on_stop)
+    try:
+        conn.close()
+    except OSError:
+        pass
+    logger.info(f"worker {args.name}: exited cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

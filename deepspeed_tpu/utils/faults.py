@@ -18,6 +18,11 @@ Spec grammar, per site: ``KIND[:ARG][@HIT]``
     raise ``IOError`` at the site (ENOSPC-style failures).
 ``delay:seconds``
     sleep — widens race / overlap windows.
+``hang``
+    sleep forever at the site (the thread never returns) — models a
+    wedged worker: a stuck compile, a deadlocked collective, a hung
+    device.  Unlike ``exit`` the process stays alive, so only timeout-
+    based supervision (heartbeats) can detect it.
 ``truncate[:bytes]``
     truncate the file handed to ``maybe_truncate`` (torn-write model);
     no arg → truncate to half the current size.
@@ -63,9 +68,9 @@ def _parse_spec(text: str) -> _Spec:
         hit = int(n)
     kind, _, arg = text.partition(":")
     kind = kind.strip().lower()
-    if kind not in ("exit", "ioerror", "delay", "truncate"):
+    if kind not in ("exit", "ioerror", "delay", "hang", "truncate"):
         raise ValueError(f"unknown fault kind {kind!r} "
-                         "(want exit|ioerror|delay|truncate)")
+                         "(want exit|ioerror|delay|hang|truncate)")
     return _Spec(kind=kind, arg=arg.strip() or None, hit=hit)
 
 
@@ -141,6 +146,10 @@ class FaultInjector:
                           + (f": {spec.arg}" if spec.arg else ""))
         if spec.kind == "delay":
             time.sleep(float(spec.arg or 0.1))
+        if spec.kind == "hang":
+            logger.error(f"fault injection: hanging thread at {site!r}")
+            while True:  # wedged, not dead — only a watchdog can tell
+                time.sleep(1.0)
 
     def maybe_truncate(self, site: str, path: str) -> None:
         """Fault site modelling a torn write: truncate ``path`` in place."""

@@ -4,10 +4,14 @@
 # The gate runs `pytest --collect-only` first: an import break (like the
 # seed's `from jax import shard_map` failure on older JAX) fails in seconds
 # with the real traceback instead of surfacing as per-file collection
-# errors mid-suite.  Then the full tier-1 command runs unchanged.
+# errors mid-suite.  The full suite then runs partitioned into
+# process-isolated pytest groups (see the comment above the loop): one
+# process accumulating every suite's XLA compilations hits a pre-existing
+# XLA:CPU backend_compile segfault around ~550 programs.
 #
-# Usage: scripts/t1.sh            # gate + full tier-1 suite
+# Usage: scripts/t1.sh            # gate + full tier-1 suite (partitioned)
 #        scripts/t1.sh --collect  # gate only (seconds)
+#        T1_GROUPS=8 scripts/t1.sh  # override the partition count
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -97,15 +101,52 @@ then
     exit 2
 fi
 
+# serving-fleet suite: imports the replica transport, the worker process
+# entrypoint, and the supervisor (chaos/fault-isolation stack)
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_fleet.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_fleet.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi
 
-echo "== t1: full suite =="
+# -- full suite, partitioned into process-isolated pytest runs ------------
+#
+# One monolithic pytest process accumulates every suite's XLA compilations
+# in a single CPU client; around ~550 programs the XLA:CPU backend_compile
+# segfaults (pre-existing upstream issue, reproducible at the seed).
+# Round-robin the test files into $T1_GROUPS groups, each its own pytest
+# process, so no single process approaches the cliff.  Per-file pass/fail
+# is unaffected (tier-1 tests are file-independent; conftest re-creates
+# fixtures per process); DOTS_PASSED aggregates across groups.
+T1_GROUPS=${T1_GROUPS:-6}
+mapfile -t T1_FILES < <(ls tests/test_*.py | sort)
+rc=0
 rm -f /tmp/_t1.log
-timeout -k 10 1800 env JAX_PLATFORMS=cpu \
-    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
-    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
-rc=${PIPESTATUS[0]}
+for ((g = 0; g < T1_GROUPS; g++)); do
+    group=()
+    for i in "${!T1_FILES[@]}"; do
+        if [ $((i % T1_GROUPS)) -eq "$g" ]; then
+            group+=("${T1_FILES[$i]}")
+        fi
+    done
+    [ ${#group[@]} -eq 0 ] && continue
+    echo "== t1: group $((g + 1))/${T1_GROUPS}: ${group[*]} =="
+    timeout -k 10 1800 env JAX_PLATFORMS=cpu \
+        python -m pytest "${group[@]}" -q -m 'not slow' \
+        --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
+    grc=${PIPESTATUS[0]}
+    # rc 5 = "no tests collected" (a group of only slow/skipped files): pass
+    if [ "$grc" -ne 0 ] && [ "$grc" -ne 5 ]; then
+        rc=$grc
+    fi
+done
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 exit $rc
