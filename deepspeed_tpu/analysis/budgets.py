@@ -71,6 +71,10 @@ _PROGRAM_KEYS = {
     "max_replicated_large_params", "max_replicated_param_bytes",
     # dtype promotion
     "max_f32_upcast_converts", "max_f32_dots",
+    # overlap (collective/compute scheduling)
+    "max_serialized_collective_pairs",
+    # entry-parameter width census + XLA memory analysis
+    "min_param_dtype_bytes", "max_param_dtype_bytes", "max_temp_bytes",
 }
 
 
@@ -101,6 +105,11 @@ def load_budgets(path: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
             raise BudgetError(
                 f"{path}: programs.{name}.max_collectives must be a table "
                 f"of per-op ceilings")
+        for key in ("min_param_dtype_bytes", "max_param_dtype_bytes"):
+            if not isinstance(table.get(key, {}), dict):
+                raise BudgetError(
+                    f"{path}: programs.{name}.{key} must be a table of "
+                    f"per-dtype byte limits")
     return programs
 
 
@@ -189,6 +198,30 @@ def check_budgets(report: Dict[str, Any],
             _ceiling("replication.replicated_param_bytes",
                      r["replicated_param_bytes"],
                      budget["max_replicated_param_bytes"])
+
+    if "max_serialized_collective_pairs" in budget:
+        ov = _require(report, "overlap", program)
+        _ceiling("overlap.serialized_pairs", ov["serialized_pairs"],
+                 budget["max_serialized_collective_pairs"])
+
+    if "min_param_dtype_bytes" in budget or "max_param_dtype_bytes" in budget:
+        pw = _require(report, "params", program)
+        by_dtype = pw["bytes_by_dtype"]
+        for dt, limit in (budget.get("min_param_dtype_bytes") or {}).items():
+            _floor(f"params.bytes_by_dtype.{dt}", by_dtype.get(dt, 0), limit)
+        for dt, limit in (budget.get("max_param_dtype_bytes") or {}).items():
+            _ceiling(f"params.bytes_by_dtype.{dt}", by_dtype.get(dt, 0),
+                     limit)
+
+    if "max_temp_bytes" in budget:
+        mem = report.get("memory")
+        if not mem or "temp_bytes" not in mem:
+            raise BudgetError(
+                f"budget for {program!r} sets max_temp_bytes but the report "
+                f"carries no XLA memory stats — a budget must never pass "
+                f"vacuously")
+        _ceiling("memory.temp_bytes", mem["temp_bytes"],
+                 budget["max_temp_bytes"])
 
     if "max_f32_upcast_converts" in budget or "max_f32_dots" in budget:
         dp = _require(report, "dtype_promotion", program)

@@ -28,6 +28,8 @@ __all__ = [
     "DonationAuditPass",
     "DtypePromotionPass",
     "HostSyncPass",
+    "OverlapPass",
+    "ParamWidthPass",
     "ReplicatedTensorPass",
     "analyze",
     "collective_bytes",
@@ -183,6 +185,142 @@ class DonationAuditPass(AnalysisPass):
             out["alias_fraction"] = round(
                 aliased_bytes / ctx.donated_intent_bytes, 4)
         return out
+
+
+# ---------------------------------------------------------------------------
+# collective/compute overlap
+# ---------------------------------------------------------------------------
+
+
+class OverlapPass(AnalysisPass):
+    """Can (and does) collective communication overlap with compute?
+
+    Three lenses over the post-optimization instruction order (the HLO
+    text order — on TPU this is the sequence the latency-hiding scheduler
+    actually emitted; on CPU it is the dataflow-topological order the
+    scheduler would work from):
+
+    * **async spans** — for every ``*-start``/``*-done`` pair, the number
+      of instructions scheduled between them.  A span of 1 means the start
+      is awaited immediately: the transfer hides nothing.  XLA:CPU emits
+      no async collectives at all, so these fields are only populated when
+      pairs exist (budgets on them belong to TPU-measured programs).
+    * **serialized chains** — a collective whose result is a DIRECT
+      operand of another collective can never overlap it; the IPG-bucket
+      design exists precisely so reductions are independent.
+    * **first-use distance** — instructions between each sync collective
+      and its first in-computation consumer.  This is the downstream slack
+      available for overlap, measurable even when the backend is fully
+      synchronous: the pipelined bucket emission in ``runtime/coalesce.py``
+      shows up here as every reduce's unflatten sitting AFTER the last
+      reduce's issue.
+    """
+
+    name = "overlap"
+
+    def run(self, module: HloModule, ctx: AnalysisContext) -> Dict[str, Any]:
+        spans: List[int] = []
+        first_use: List[int] = []
+        serialized = 0
+        n_sync = 0
+        overlapped_starts = 0
+        for comp in module.computations.values():
+            insts = comp.instructions
+            index = {inst.name: i for i, inst in enumerate(insts)}
+            coll_names = set()
+            consumers: Dict[str, int] = {}
+            for i, inst in enumerate(insts):
+                for op in inst.operands:
+                    if op not in consumers:
+                        consumers[op] = i
+                m = _COLLECTIVE_RE.match(inst.opcode)
+                if m is not None and m.group(2) != "-done":
+                    coll_names.add(inst.name)
+            windows: List[tuple] = []
+            for i, inst in enumerate(insts):
+                m = _COLLECTIVE_RE.match(inst.opcode)
+                if m is None:
+                    continue
+                suffix = m.group(2)
+                if any(op in coll_names for op in inst.operands
+                       if suffix != "-done"):
+                    serialized += 1
+                if suffix == "-done":
+                    starts = [index[op] for op in inst.operands
+                              if op in index]
+                    if starts:
+                        windows.append((min(starts), i))
+                        spans.append(i - min(starts))
+                elif suffix is None:
+                    n_sync += 1
+                    use = consumers.get(inst.name)
+                    first_use.append((use - i) if use is not None
+                                     else len(insts) - i)
+            for lo, hi in windows:
+                if any(lo < index[n] < hi for n in coll_names
+                       if index[n] != lo):
+                    overlapped_starts += 1
+        out: Dict[str, Any] = {
+            "n_async_pairs": len(spans),
+            "n_sync_collectives": n_sync,
+            "serialized_pairs": serialized,
+            "overlapped_async_pairs": overlapped_starts,
+        }
+        if spans:
+            out["async_span_min"] = int(min(spans))
+            out["async_span_mean"] = round(sum(spans) / len(spans), 1)
+            out["async_span_max"] = int(max(spans))
+        if first_use:
+            out["first_use_distance_min"] = int(min(first_use))
+            out["first_use_distance_mean"] = round(
+                sum(first_use) / len(first_use), 1)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# entry-parameter width census
+# ---------------------------------------------------------------------------
+
+
+class ParamWidthPass(AnalysisPass):
+    """Entry-parameter bytes grouped by dtype.
+
+    The storage-width oracle for quantized programs: a decode step over an
+    int8/int4 base must show its weight bytes under ``s8``/``s4`` — if the
+    engine were dequantizing ahead of the jitted step (or holding a bf16
+    shadow copy), the bytes would show up under ``bf16`` instead.  Unlike
+    async-collective behavior this is deterministic across backends, so
+    it is the CPU-checkable half of "the kernel path reads quantized
+    weights"; ``max_temp_bytes`` (memory_analysis) covers the in-program
+    dequant-temp half.
+    """
+
+    name = "params"
+
+    def run(self, module: HloModule, ctx: AnalysisContext) -> Dict[str, Any]:
+        entry = module.entry
+        if entry is None:
+            return {"error": "no entry computation"}
+        by_dtype: Dict[str, int] = collections.Counter()
+        n_leaves = 0
+        largest = {"bytes": 0}
+        params = entry.parameters()
+        for num, inst in sorted(params.items()):
+            for leaf in inst.shape.leaves():
+                by_dtype[leaf.dtype] += leaf.nbytes
+                n_leaves += 1
+            b = inst.shape.nbytes
+            if b > largest["bytes"]:
+                largest = {"param": num, "name": inst.name, "bytes": int(b),
+                           "dtype": inst.shape.dtype
+                           if not inst.shape.is_tuple else "tuple"}
+        return {
+            "n_params": len(params),
+            "n_leaves": n_leaves,
+            "bytes_by_dtype": {k: int(v) for k, v in sorted(by_dtype.items())},
+            "total_bytes": int(sum(by_dtype.values())),
+            "largest": largest,
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -352,7 +490,8 @@ class ReplicatedTensorPass(AnalysisPass):
 
 def default_passes() -> List[AnalysisPass]:
     return [CollectiveCensusPass(), DonationAuditPass(), HostSyncPass(),
-            DtypePromotionPass(), ReplicatedTensorPass()]
+            DtypePromotionPass(), ReplicatedTensorPass(), OverlapPass(),
+            ParamWidthPass()]
 
 
 def analyze(hlo: Union[str, HloModule],

@@ -10,8 +10,8 @@ collective/aliasing *structure* matches the real thing while a full
 registry compile stays under a minute on a CI box.
 
 Program names are the budget keys: ``train_step@zero{0..3}``,
-``train_step@lora``, ``decode_step@v2``, ``spec_decode_step@v2``,
-``onebit_step``.
+``train_step@lora``, ``decode_step@v2``, ``decode_step@v2_quant``,
+``spec_decode_step@v2``, ``onebit_step``.
 """
 
 from __future__ import annotations
@@ -162,7 +162,7 @@ def _onebit_program() -> ProgramArtifact:
                            ctx=ctx)
 
 
-def _decode_v2_program() -> ProgramArtifact:
+def _decode_v2_artifact(name: str, **v2_extra: Any) -> ProgramArtifact:
     import jax
     import numpy as np
 
@@ -177,7 +177,7 @@ def _decode_v2_program() -> ProgramArtifact:
     # (the budget enforces exactly that)
     v2 = V2Config(max_tokens_per_step=64, max_seqs=4, block_size=8,
                   num_blocks=64, max_blocks_per_seq=8, dtype="bfloat16",
-                  enable_prefix_cache=True)
+                  enable_prefix_cache=True, **v2_extra)
     eng = InferenceEngineV2(cfg, params, v2)
     seqs = v2.max_seqs
     tokens = np.zeros((seqs,), np.int32)
@@ -188,7 +188,7 @@ def _decode_v2_program() -> ProgramArtifact:
         eng.params, eng.caches, tokens, positions, tables,
         ctx_lens).compile()
     ctx = AnalysisContext(
-        program="decode_step@v2",
+        program=name,
         compute_dtype="bf16",
         mesh_devices=1,
         # the KV caches are donated (donate_argnums=(1,)) — decode must
@@ -196,9 +196,24 @@ def _decode_v2_program() -> ProgramArtifact:
         donated_intent_bytes=_tree_bytes(eng.caches),
         memory_stats=_memory_stats(compiled),
     )
-    return ProgramArtifact(name="decode_step@v2",
-                           hlo_text=compiled.as_text(), ctx=ctx,
+    return ProgramArtifact(name=name, hlo_text=compiled.as_text(), ctx=ctx,
                            meta={"v2": dataclasses.asdict(v2)})
+
+
+def _decode_v2_program() -> ProgramArtifact:
+    return _decode_v2_artifact("decode_step@v2")
+
+
+def _decode_v2_quant_program() -> ProgramArtifact:
+    # the quantized-serving flagship: same decode step over a W8A16 base.
+    # group=704 collapses to group == K for every projection of the subject
+    # (wq/wk/wv/w_in/w_gate K=256 shrink to 256, w_out K=704 keeps 704), so
+    # every leaf is Pallas-kernel-eligible and the budget can prove the
+    # program reads weights at the quantized width: entry params carry the
+    # projection bytes as s8, and temp stays below one (K, N) bf16 matrix —
+    # i.e. no full-matrix dequant anywhere
+    return _decode_v2_artifact("decode_step@v2_quant",
+                               quantize_bits=8, quantize_group=704)
 
 
 def _spec_decode_program() -> ProgramArtifact:
@@ -251,6 +266,7 @@ _PROGRAMS: Dict[str, Callable[[], ProgramArtifact]] = {
     "train_step@zero3": _zero_stage_program(3),
     "train_step@lora": _lora_program,
     "decode_step@v2": _decode_v2_program,
+    "decode_step@v2_quant": _decode_v2_quant_program,
     "spec_decode_step@v2": _spec_decode_program,
     "onebit_step": _onebit_program,
 }

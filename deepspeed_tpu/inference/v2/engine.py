@@ -77,6 +77,12 @@ class V2Config:
     # one multi-position forward with in-graph accept/reject
     spec_mode: str = "off"  # "off" | "draft" | "self_draft"
     spec_k: int = 4
+    # weight-only quantization of the served base (inference/quantization.py):
+    # attention/MLP projections become ``QuantizedWeight`` nodes that the
+    # Pallas mixed GEMM dequantizes in-kernel, so decode reads weights at the
+    # quantized width (int8: K·N bytes, int4: K·N/2) instead of 2·K·N bf16
+    quantize_bits: int = 0  # 0 = off; 4 / 6 / 8 = W4A16 / W6A16 / W8A16
+    quantize_group: int = 256  # per-group scale granularity along K
 
 
 # ---------------------------------------------------------------------------
@@ -413,6 +419,11 @@ class InferenceEngineV2:
                 "(deepspeed_tpu.init_inference), which supports alibi")
         self.cfg = config or V2Config()
         self.model_cfg = dataclasses.replace(model_config, dtype=self.cfg.dtype)
+        if self.cfg.quantize_bits:
+            from ..quantization import quantize_on_host
+
+            params = quantize_on_host(params, self.cfg.quantize_bits,
+                                      self.cfg.quantize_group)
         self.params = params
         # one block reserved as write-scratch for padded tokens
         self.kv = KVCacheManager(self.cfg.num_blocks - 1, self.cfg.block_size,

@@ -36,9 +36,25 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import quantizer as quantizer_ops
+from ..ops.pallas.flash_attention import aligned_divisor
+from ..ops.pallas.mixed_gemm import (QuantizedWeight, dequantize_gemm_weight,
+                                     mixed_gemm_frozen, quantize_gemm_weight)
 from .config import LoRAConfig, QuantizationConfig
 
 _FP8_DTYPE = jnp.float8_e4m3fn
+
+#: materialization dtype for dequant fallbacks (satellite of the mixed-GEMM
+#: PR): the compute dtype everywhere in this repo is bf16, and a f32 default
+#: doubled the (K, N) temp spike wherever full dequant still runs (export,
+#: f32-activation fallback)
+_COMPUTE_DTYPE = jnp.bfloat16
+
+#: (q_bits, mantissa_bits) formats stored in the Pallas row-group GEMM
+#: layout — the kernel dequantizes these *in-kernel*, so the frozen base
+#: streams from HBM at the quantized width (int8: K·N bytes, int4: K·N/2,
+#: fp6: 3·K·N/4) instead of 2·K·N bf16.  fp8 (8, 3) keeps the flat
+#: blockwise layout: the kernel has no e4m3 decode path.
+_GEMM_FORMATS = frozenset({(8, 0), (4, 0), (6, 2)})
 
 #: leaf names that constitute the adapter (the only trainable, checkpointable
 #: state of a PEFT run)
@@ -92,6 +108,16 @@ class QuantizedBaseWeight:
     optionally ``expert``) so ``lax.scan`` layer slicing and per-layer vmap
     both work; ``inner_shape`` records the trailing ``(K, N)`` each block
     grid decodes back to.
+
+    ``layout`` selects the storage format:
+
+    * ``"gemm"`` — the Pallas row-group layout of
+      ``ops/pallas/mixed_gemm.quantize_gemm_weight`` (codes ``(…, Kp, N)``,
+      scales ``(…, K/group, N)``): the forward runs the mixed-precision
+      kernel directly, no dequantized temp.  Default for int8/int4/fp6.
+    * ``"block"`` — the flat blockwise codecs of ``ops/quantizer.py``
+      (codes ``(…, K, N)``-shaped grid, scales ``(…, nblocks)``); the
+      forward dequantizes on the fly.  Kept for fp8 e4m3.
     """
 
     codes: Any
@@ -100,12 +126,13 @@ class QuantizedBaseWeight:
     mantissa_bits: int = 3
     group_size: int = 512
     inner_shape: Tuple[int, ...] = ()
+    layout: str = "block"
 
     def tree_flatten_with_keys(self):
         children = ((jax.tree_util.GetAttrKey("codes"), self.codes),
                     (jax.tree_util.GetAttrKey("scales"), self.scales))
         aux = (self.q_bits, self.mantissa_bits, self.group_size,
-               tuple(self.inner_shape))
+               tuple(self.inner_shape), self.layout)
         return children, aux
 
     @classmethod
@@ -120,7 +147,15 @@ class QuantizedBaseWeight:
     def ndim(self) -> int:
         return len(self.shape)
 
-    def dequantize(self, dtype=jnp.float32) -> jax.Array:
+    def as_gemm_weight(self) -> QuantizedWeight:
+        """View gemm-layout codes as the Pallas kernel's pytree node."""
+        assert self.layout == "gemm", self.layout
+        return QuantizedWeight(self.codes, self.scales, self.q_bits,
+                               self.group_size, k=int(self.inner_shape[-2]))
+
+    def dequantize(self, dtype=_COMPUTE_DTYPE) -> jax.Array:
+        if self.layout == "gemm":
+            return dequantize_gemm_weight(self.as_gemm_weight()).astype(dtype)
         deq = partial(_dequant_matrix, q_bits=self.q_bits,
                       mantissa_bits=self.mantissa_bits,
                       group_size=self.group_size,
@@ -137,11 +172,30 @@ class QuantizedBaseWeight:
 def quantize_base_weight(w: jax.Array, qcfg: QuantizationConfig
                          ) -> QuantizedBaseWeight:
     """Quantize a ``(…, K, N)`` weight per-matrix (blocks never straddle the
-    stack dims, so a scan-sliced layer dequantizes standalone)."""
+    stack dims, so a scan-sliced layer dequantizes standalone).  Kernel-
+    compatible formats (int8/int4/fp6) store the Pallas row-group layout so
+    the forward can run the mixed GEMM without materializing the matrix."""
     if w.ndim < 2:
         raise ValueError(f"need a matrix to quantize, got shape {w.shape}")
     inner = tuple(w.shape[-2:])
     lead = tuple(w.shape[:-2])
+    fmt = (qcfg.q_bits, qcfg.mantissa_bits)
+    if fmt in _GEMM_FORMATS:
+        K = inner[0]
+        group = qcfg.group_size
+        if K % group != 0:  # mirror quantize_gemm_weight's group shrink
+            group = aligned_divisor(K, group, 1) or K
+        quant = lambda m: (lambda q: (q.codes, q.scales))(
+            quantize_gemm_weight(m, bits=qcfg.q_bits, group=group))
+        if lead:
+            codes, scales = jax.vmap(quant)(w.reshape((-1,) + inner))
+            codes = codes.reshape(lead + codes.shape[1:])
+            scales = scales.reshape(lead + scales.shape[1:])
+        else:
+            codes, scales = quant(w)
+        return QuantizedBaseWeight(codes, scales, qcfg.q_bits,
+                                   qcfg.mantissa_bits, group, inner,
+                                   layout="gemm")
     quant = partial(_quant_matrix, q_bits=qcfg.q_bits,
                     mantissa_bits=qcfg.mantissa_bits,
                     group_size=qcfg.group_size)
@@ -181,7 +235,7 @@ class LoRAWeight:
     def tree_unflatten(cls, aux, children):
         return cls(*children, aux[0])
 
-    def base_materialized(self, dtype=jnp.float32) -> jax.Array:
+    def base_materialized(self, dtype=_COMPUTE_DTYPE) -> jax.Array:
         if isinstance(self.base, QuantizedBaseWeight):
             return self.base.dequantize(dtype)
         return self.base.astype(dtype)
@@ -192,11 +246,23 @@ def _is_lora(x: Any) -> bool:
 
 
 def lora_forward(x: jax.Array, w: LoRAWeight) -> jax.Array:
-    """``x @ base + scaling · (x @ A) @ B``; the base path runs under
-    ``stop_gradient`` so no backward graph ever materializes for it."""
+    """``x @ base + scaling · (x @ A) @ B``.
+
+    A gemm-layout quantized base at the bf16 compute dtype runs the Pallas
+    mixed GEMM: codes stream from HBM at the quantized width and dequantize
+    in-kernel, so no ``(K, N)`` bf16 temp ever exists; the kernel's custom
+    VJP sends the cotangent to ``x`` only, preserving the frozen-base
+    contract.  Every other base (dense, fp8, f32 activations — where the
+    caller wants full f32 matmul precision) keeps the materialize-then-dot
+    path under ``stop_gradient``."""
     dt = x.dtype
-    mat = jax.lax.stop_gradient(w.base_materialized(dt))
-    y = x @ mat
+    base = w.base
+    if (isinstance(base, QuantizedBaseWeight) and base.layout == "gemm"
+            and base.codes.ndim == 2 and dt == _COMPUTE_DTYPE):
+        y = mixed_gemm_frozen(x, base.as_gemm_weight())
+    else:
+        mat = jax.lax.stop_gradient(w.base_materialized(dt))
+        y = x @ mat
     ax = x @ w.lora_a.astype(dt)
     return y + (ax @ w.lora_b.astype(dt)) * w.scaling
 
@@ -262,12 +328,15 @@ def _axes_for_node(node: LoRAWeight, w_axes, base_weight_sharding: int
         base_axes = w_axes
     if isinstance(node.base, QuantizedBaseWeight):
         q = node.base
-        # codes/scales replace the (K, N) plane with a block grid the logical
-        # in/out axes no longer describe — only the stack axes survive
-        base_axes = QuantizedBaseWeight(stack_lead + (None, None),
-                                        stack_lead + (None,),
-                                        q.q_bits, q.mantissa_bits,
-                                        q.group_size, tuple(q.inner_shape))
+        # codes/scales replace the (K, N) plane with a code grid the logical
+        # in/out axes no longer describe — only the stack axes survive.  The
+        # trailing rank differs per layout (gemm scales are (K/group, N),
+        # block scales are flat (nblocks,)), so derive it from the arrays.
+        base_axes = QuantizedBaseWeight(
+            stack_lead + (None,) * (q.codes.ndim - len(stack_lead)),
+            stack_lead + (None,) * (q.scales.ndim - len(stack_lead)),
+            q.q_bits, q.mantissa_bits,
+            q.group_size, tuple(q.inner_shape), q.layout)
     return LoRAWeight(base_axes,
                       stack_lead + (in_ax, None),
                       stack_lead + (None, out_ax),
@@ -351,7 +420,13 @@ def merge_lora_weights(tree, dtype=None):
     ``OptimizedLinear.merge_lora_weights``."""
 
     def merge(n: LoRAWeight):
-        mat = n.base_materialized(jnp.float32)
+        # quantized bases materialize in the compute dtype (the codes carry
+        # at most ~8 significant bits, so bf16 loses nothing past the
+        # quantization error and the temp spike halves); dense bases merge
+        # in f32 exactly as stored
+        mat = (n.base_materialized(_COMPUTE_DTYPE).astype(jnp.float32)
+               if isinstance(n.base, QuantizedBaseWeight)
+               else n.base.astype(jnp.float32))
         delta = jnp.einsum("...kr,...rn->...kn",
                            n.lora_a.astype(jnp.float32),
                            n.lora_b.astype(jnp.float32)) * n.scaling

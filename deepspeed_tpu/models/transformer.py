@@ -30,7 +30,7 @@ from jax import lax
 
 from ..linear.optimized_linear import (LoRAWeight, expand_axes_for_lora,
                                        lora_forward)
-from ..ops.pallas.mixed_gemm import QuantizedWeight, mixed_gemm
+from ..ops.pallas.mixed_gemm import QuantizedWeight, mixed_gemm_frozen
 
 
 @dataclasses.dataclass(frozen=True)
@@ -508,7 +508,7 @@ def _lin(x, p, w_key, b_key):
     if isinstance(w, LoRAWeight):  # frozen (possibly quantized) base + LoRA
         y = lora_forward(x, w)
     elif isinstance(w, QuantizedWeight):  # W8A16/W4A16 in-kernel dequant
-        y = mixed_gemm(x, w)
+        y = mixed_gemm_frozen(x, w)
     else:
         y = x @ w.astype(x.dtype)
     if b_key in p:

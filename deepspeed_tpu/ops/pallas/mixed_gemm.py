@@ -24,10 +24,11 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -110,6 +111,54 @@ def quantize_gemm_weight(w: jax.Array, bits: int = 8,
             codes = jnp.pad(codes, pad)
         codes = pack_int4(codes[..., 0::2, :], codes[..., 1::2, :])
     return QuantizedWeight(codes, scale[..., 0, :], bits, group, k=K)
+
+
+# ---------------------------------------------------------------------------
+# tile selection: heuristic default + autotuner override
+# ---------------------------------------------------------------------------
+
+#: (M_padded, N, K, bits) → (tm, tn), installed by the autotuner
+#: (``autotuning.autotuner.tune_gemm_tiles``).  The heuristic in
+#: ``_flatten_pad_tiles`` stays the default; an override only applies when it
+#: tiles the problem legally, so a stale entry can never break a call.
+_TILE_OVERRIDES: Dict[Tuple[int, int, int, int], Tuple[int, int]] = {}
+
+
+def set_gemm_tiles(m: int, n: int, k: int, bits: int,
+                   tm: int, tn: int) -> None:
+    """Pin the (tm, tn) tiles for one (padded-M, N, K, bits) GEMM shape."""
+    _TILE_OVERRIDES[(m, n, k, bits)] = (int(tm), int(tn))
+
+
+def clear_gemm_tiles() -> None:
+    _TILE_OVERRIDES.clear()
+
+
+def _tile_legal(m: int, n: int, tm: int, tn: int) -> bool:
+    return (tm > 0 and tn > 0 and m % tm == 0 and n % tn == 0
+            and (tm % 8 == 0 or tm == m) and (tn % 128 == 0 or tn == n))
+
+
+def gemm_tile_candidates(m: int, n: int, pad_m: int = 0
+                         ) -> List[Tuple[int, int]]:
+    """Legal (tm, tn) tile pairs for an (m+pad_m, K) × (K, n) problem —
+    the autotuner's search space.  Every pair divides the padded M and N
+    with Mosaic-legal alignment; the heuristic pick is always a member."""
+    mp = m + pad_m
+    tms = [d for d in (8, 16, 32, 64, 128, 256, 512) if mp % d == 0]
+    if not tms:
+        tms = [mp]
+    tns = [d for d in (128, 256, 512) if n % d == 0] or [n]
+    return [(tm, tn) for tm in tms for tn in tns]
+
+
+def _apply_tile_override(mp: int, N: int, K: int, bits: int,
+                         tm: Optional[int], tn: Optional[int]
+                         ) -> Tuple[Optional[int], Optional[int]]:
+    ov = _TILE_OVERRIDES.get((mp, N, K, bits))
+    if ov is not None and _tile_legal(mp, N, ov[0], ov[1]):
+        return ov
+    return tm, tn
 
 
 def _unpack_int4(c):
@@ -271,6 +320,7 @@ def int8_gemm(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
             f"would be silently wrong")
     N = qw.out_features
     x2, lead, M, pad_m, tm, tn = _flatten_pad_tiles(x, N)
+    tm, tn = _apply_tile_override(M + pad_m, N, K, qw.bits, tm, tn)
     # int8 MXU tiles want lane-aligned k-tiles; no group==K escape here —
     # a misaligned single tile would pass interpret mode and fail Mosaic
     usable = (tm is not None and tn is not None and K % qw.group == 0
@@ -322,6 +372,7 @@ def mixed_gemm(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     # multiple so the kernel path — the whole bandwidth win — is never lost
     # to an unlucky batch·seq product
     x2, lead, M, pad_m, tm, tn = _flatten_pad_tiles(x, N)
+    tm, tn = _apply_tile_override(M + pad_m, N, K, qw.bits, tm, tn)
     # int4 packs two codes per byte (group must be even); fp6 packs 4 K-rows
     # per 3 byte-rows (group must divide by 4, and the byte-row tile must be
     # sublane-aligned); int8 has no pack constraint
@@ -338,3 +389,44 @@ def mixed_gemm(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     else:
         out = x2 @ dequantize_gemm_weight(qw).astype(x2.dtype)
     return out.reshape(*lead, N)
+
+
+# ---------------------------------------------------------------------------
+# frozen-weight entry point: differentiable in x, never in the codes
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _frozen_gemm(bits, group, k, x, codes, scales):
+    return mixed_gemm(x, QuantizedWeight(codes, scales, bits, group, k))
+
+
+def _frozen_gemm_fwd(bits, group, k, x, codes, scales):
+    return _frozen_gemm(bits, group, k, x, codes, scales), (codes, scales)
+
+
+def _frozen_gemm_bwd(bits, group, k, res, g):
+    codes, scales = res
+    # cotangent flows to the activations only: dx = g @ W^T with W
+    # dequantized at the cotangent dtype.  The weight is frozen, so its
+    # cotangents are structural zeros (float0 for the integer codes) — the
+    # backward never builds a dW buffer.
+    w = dequantize_gemm_weight(QuantizedWeight(codes, scales, bits, group, k))
+    gx = g @ jnp.swapaxes(w.astype(g.dtype), -1, -2)
+    return (gx, np.zeros(codes.shape, dtype=jax.dtypes.float0),
+            jnp.zeros(scales.shape, scales.dtype))
+
+
+_frozen_gemm.defvjp(_frozen_gemm_fwd, _frozen_gemm_bwd)
+
+
+def mixed_gemm_frozen(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
+    """:func:`mixed_gemm` for frozen weights inside a differentiated graph.
+
+    ``pallas_call`` has no JVP rule, so the bare kernel breaks under
+    ``jax.grad`` even when the weight itself needs no gradient (the LoRA
+    base path: earlier layers' adapters still need the cotangent to flow
+    *through* this matmul).  The custom VJP keeps the kernel forward and
+    differentiates w.r.t. ``x`` only, via the dequant oracle — which is a
+    training-only cost; inference traces never call it."""
+    return _frozen_gemm(qw.bits, qw.group, qw.k, x, qw.codes, qw.scales)

@@ -243,20 +243,38 @@ def reduce_bucketed(plan: BucketPlan, tree: Any,
     as LOCAL shards (callers running under ``shard_map`` give those leaves
     sharded out_specs).  Runs inside jit/shard_map — no collective happens
     here except the ones the callbacks issue, one per bucket.
+
+    Emission is pipelined: every bucket's flatten + collective is issued
+    BEFORE any bucket's unflatten, and buckets are issued in reverse plan
+    order (backward produces the later layers' gradients first, and buckets
+    fill in leaf order, so the last bucket is the first whose inputs are
+    ready).  The unflatten of bucket *i* is the only data-dependent consumer
+    of its collective; deferring all consumers to a second phase means no
+    collective has a consumer between itself and the next collective's
+    issue, which is exactly the dataflow shape the latency-hiding scheduler
+    needs to run reduction of bucket *i* under the backward compute that
+    feeds bucket *i+1*.  Numerics and the collective census are unchanged —
+    this only reorders independent ops.
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     out: List[Any] = list(leaves)
-    for bucket in plan.buckets:
+    reduced: List[jax.Array] = [None] * len(plan.buckets)
+    for bi in range(len(plan.buckets) - 1, -1, -1):
+        bucket = plan.buckets[bi]
         if bucket.scatter:
             if reduce_scatter is None:
                 raise ValueError("plan has scatter buckets but no "
                                  "reduce_scatter callback")
             flat = flatten_bucket_shard_major(bucket, leaves, plan.world)
-            shard = reduce_scatter(bucket, flat)
-            pairs = unflatten_bucket_shard(bucket, shard, plan.world)
+            reduced[bi] = reduce_scatter(bucket, flat)
         else:
             flat = flatten_bucket(bucket, leaves)
-            pairs = unflatten_bucket(bucket, reduce_flat(bucket, flat))
+            reduced[bi] = reduce_flat(bucket, flat)
+    for bucket, red in zip(plan.buckets, reduced):
+        if bucket.scatter:
+            pairs = unflatten_bucket_shard(bucket, red, plan.world)
+        else:
+            pairs = unflatten_bucket(bucket, red)
         for i, v in pairs:
             out[i] = v
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -412,13 +412,112 @@ def run_spec_sweep(rates: List[float], duration_s: float = 6.0,
     }
 
 
+# -- mixed-GEMM kernel microbench ------------------------------------------
+
+
+def _time_fn(fn, args, warmup: int, iters: int) -> float:
+    fn(*args).block_until_ready()  # compile
+    for _ in range(max(0, warmup - 1)):
+        fn(*args).block_until_ready()
+    t0 = time.monotonic()
+    for _ in range(max(1, iters)):
+        fn(*args).block_until_ready()
+    return (time.monotonic() - t0) / max(1, iters)
+
+
+def run_gemm_sweep(ms=(1, 2, 4, 8, 64),
+                   shapes=((256, 256), (256, 704), (704, 256)),
+                   bits_list=(8, 4, 6), groups=(0, 128),
+                   warmup=1, iters=3, tune_tiles=False, seed=0) -> dict:
+    """Kernel-vs-fallback microbench for the Pallas mixed GEMM.
+
+    Sweeps bits × group × (M, N, K) — decode-shaped M=1..8 plus a prefill
+    point — timing the in-kernel-dequant path (``mixed_gemm``) against the
+    dequantize+matmul fallback compiled as its own program (the path the
+    kernel replaces: it materializes the full (K, N) weight every call).
+    Parity columns record kernel-vs-fallback max abs/rel error — the
+    portable signal; on ``JAX_PLATFORMS=cpu`` the kernel runs in Pallas
+    interpret mode, so CPU *timings* only sanity-check plumbing, never
+    perf.  ``tune_tiles`` additionally runs the measured tile search
+    (``autotuning.autotuner.tune_gemm_tiles``) per cell and records the
+    tuned tiles + tuned kernel time.
+
+    The (N, K) defaults are the flagship subject's projections: attention
+    256×256, MLP up 256→704, MLP down 704→256.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..autotuning.autotuner import tune_gemm_tiles as _tune
+    from ..ops.pallas import mixed_gemm as mg
+
+    rng = np.random.default_rng(seed)
+    cells = []
+    for (k, n) in shapes:
+        for bits in bits_list:
+            for g in groups:
+                group = k if g == 0 else g
+                if k % group:
+                    continue  # quantizer would shrink it: not a new cell
+                w = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+                qw = mg.quantize_gemm_weight(w, bits=bits, group=group)
+                for m in ms:
+                    x = jnp.asarray(rng.standard_normal((m, k)),
+                                    jnp.bfloat16)
+                    # fresh jits per cell: tile overrides bind at trace
+                    # time, and qw rides as an ARGUMENT so XLA cannot
+                    # constant-fold the fallback's dequant away
+                    kern = jax.jit(lambda xx, q: mg.mixed_gemm(xx, q))
+                    orac = jax.jit(
+                        lambda xx, q:
+                        xx @ mg.dequantize_gemm_weight(q).astype(xx.dtype))
+                    y_k = np.asarray(kern(x, qw), np.float32)
+                    y_o = np.asarray(orac(x, qw), np.float32)
+                    err = float(np.max(np.abs(y_k - y_o)))
+                    ref = float(np.max(np.abs(y_o))) or 1.0
+                    cell = {
+                        "m": m, "n": n, "k": k, "bits": bits,
+                        "group": int(qw.group),
+                        "kernel_s": round(
+                            _time_fn(kern, (x, qw), warmup, iters), 6),
+                        "dequant_dot_s": round(
+                            _time_fn(orac, (x, qw), warmup, iters), 6),
+                        "max_abs_err": round(err, 6),
+                        "rel_err": round(err / ref, 6),
+                    }
+                    cell["kernel_speedup"] = round(
+                        cell["dequant_dot_s"] / cell["kernel_s"], 3) \
+                        if cell["kernel_s"] else 0.0
+                    if tune_tiles:
+                        tuned = _tune(m, n, k, bits=bits, group=group,
+                                      warmup=warmup, iters=iters, seed=seed)
+                        tkern = jax.jit(
+                            lambda xx, q: mg.mixed_gemm(xx, q))
+                        cell["tuned_tiles"] = list(tuned["best"])
+                        cell["tuned_kernel_s"] = round(
+                            _time_fn(tkern, (x, qw), warmup, iters), 6)
+                        mg.clear_gemm_tiles()
+                    cells.append(cell)
+    return {
+        "subject": "random W{bits}A16 problems at the flagship subject's "
+                   "projection shapes; x bf16, scales f32",
+        "note": "on JAX_PLATFORMS=cpu the kernel runs in Pallas interpret "
+                "mode — CPU timings check plumbing only; the parity "
+                "columns (kernel vs full-matrix dequant+dot) are the "
+                "portable signal, speedups are only meaningful on TPUs",
+        "warmup": warmup, "iters": iters, "tile_tuning": bool(tune_tiles),
+        "cells": cells,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
     p = argparse.ArgumentParser(prog="dstpu-serving-bench")
     p.add_argument("--out", default=None,
                    help="merge results into this BENCH_EVIDENCE.json")
-    p.add_argument("--mode", choices=["serving", "prefix", "spec"],
+    p.add_argument("--mode", choices=["serving", "prefix", "spec", "gemm"],
                    default="serving")
     p.add_argument("--rates", default="2,8,24")
     p.add_argument("--duration_s", type=float, default=8.0)
@@ -428,10 +527,22 @@ def main(argv=None) -> int:
     p.add_argument("--tenants", type=int, default=2)
     p.add_argument("--spec_k", type=int, default=4)
     p.add_argument("--spec_train_steps", type=int, default=0)
+    p.add_argument("--gemm_ms", default="1,2,4,8,64",
+                   help="comma-separated M values for --mode gemm")
+    p.add_argument("--gemm_bits", default="8,4,6")
+    p.add_argument("--gemm_iters", type=int, default=3)
+    p.add_argument("--tune_tiles", action="store_true",
+                   help="run the measured tile search per gemm cell")
     args = p.parse_args(argv)
 
     rates = [float(r) for r in args.rates.split(",")]
-    if args.mode == "spec":
+    if args.mode == "gemm":
+        result = run_gemm_sweep(
+            ms=tuple(int(m) for m in args.gemm_ms.split(",")),
+            bits_list=tuple(int(b) for b in args.gemm_bits.split(",")),
+            iters=args.gemm_iters, tune_tiles=args.tune_tiles)
+        key = "mixed_gemm"
+    elif args.mode == "spec":
         result = run_spec_sweep(
             rates, duration_s=args.duration_s, spec_k=args.spec_k,
             spec_train_steps=args.spec_train_steps,

@@ -345,7 +345,9 @@ def build_engine_factory(args) -> Callable[[], "object"]:
                   enable_prefix_cache=args.enable_prefix_cache,
                   prefix_cache_min_tokens=args.prefix_cache_min_tokens,
                   prefix_eviction=args.prefix_eviction,
-                  spec_mode=args.spec_mode, spec_k=args.spec_k)
+                  spec_mode=args.spec_mode, spec_k=args.spec_k,
+                  quantize_bits=args.quantize_bits,
+                  quantize_group=args.quantize_group)
     draft_params, draft_cfg, spec_heads = None, None, None
     if args.spec_mode == "draft":
         draft_cfg = tfm.get_config(args.spec_draft_model or args.model,
@@ -390,7 +392,9 @@ def engine_argv_from_args(args) -> List[str]:
             "--prefix_eviction", args.prefix_eviction,
             "--prefix_cache_min_tokens", str(args.prefix_cache_min_tokens),
             "--spec_mode", args.spec_mode, "--spec_k", str(args.spec_k),
-            "--spec_train_steps", str(args.spec_train_steps)]
+            "--spec_train_steps", str(args.spec_train_steps),
+            "--quantize_bits", str(args.quantize_bits),
+            "--quantize_group", str(args.quantize_group)]
     if args.enable_prefix_cache:
         argv.append("--enable_prefix_cache")
     if args.spec_draft_model:
@@ -463,6 +467,15 @@ def add_engine_cli_args(p) -> None:
                    help="minimum shareable prefix length to take a cache hit")
     p.add_argument("--prefix_eviction", choices=["lru", "none"],
                    default="lru")
+    p.add_argument("--quantize_bits", type=int, default=0,
+                   choices=[0, 4, 6, 8],
+                   help="weight-only quantization of the served base: "
+                        "projections become int4/fp6/int8 codes the Pallas "
+                        "mixed GEMM dequantizes in-kernel (0 = bf16 base)")
+    p.add_argument("--quantize_group", type=int, default=256,
+                   help="per-group scale granularity along K for "
+                        "--quantize_bits (shrinks to a divisor of K per "
+                        "projection when K is not a multiple)")
     p.add_argument("--spec_mode", choices=["off", "draft", "self_draft"],
                    default="off",
                    help="speculative decoding: 'draft' proposes with a small "

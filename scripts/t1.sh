@@ -112,6 +112,17 @@ then
     exit 2
 fi
 
+# mixed-GEMM path suite: imports the Pallas kernel wiring (linear/ frozen
+# base, models/ scan path, inference/v2 quantized serving)
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_mixed_gemm_path.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_mixed_gemm_path.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi
