@@ -13,7 +13,11 @@ Exceeds the reference DeepSpeed, which ships a monitor fan-out
   timelines / M engine steps / K infra events, dumped to
   ``$DSTPU_FLIGHT_DIR`` on crash or injected fault;
 * :mod:`.prometheus` — text-exposition builder (HELP/TYPE, histograms,
-  labels) plus a strict format parser used as the test oracle.
+  labels) plus a strict format parser used as the test oracle;
+* :mod:`.replay` — workload capture at the broker, seeded heavy-tail
+  synthesis, open-loop trace replay against a replica pool, and the
+  declarative ``slo.toml`` regression gate
+  (``serving/bench.py --mode replay``).
 
 Server surfaces (``serving/server.py``): ``GET /debug/requests`` (recent
 timelines), ``GET /debug/trace`` (Perfetto JSON), ``GET /debug/profile``
@@ -28,10 +32,16 @@ Tracing never enters a jitted computation, so the analysis budgets
 from .prometheus import (DEFAULT_MS_BUCKETS, ExpositionBuilder,
                          ExpositionError, Histogram, parse_exposition)
 from .recorder import FlightRecorder, load_dump, recorder
+from .replay import (SLOError, SLOViolation, WorkloadCapture, WorkloadError,
+                     WorkloadRequest, check_slo, load_slos, load_workload,
+                     replay_workload, save_workload, synthesize_workload)
 from .trace import Span, Tracer, add_event, add_span, span, tracer
 
 __all__ = [
     "DEFAULT_MS_BUCKETS", "ExpositionBuilder", "ExpositionError",
-    "FlightRecorder", "Histogram", "Span", "Tracer", "add_event", "add_span",
-    "load_dump", "parse_exposition", "recorder", "span", "tracer",
+    "FlightRecorder", "Histogram", "SLOError", "SLOViolation", "Span",
+    "Tracer", "WorkloadCapture", "WorkloadError", "WorkloadRequest",
+    "add_event", "add_span", "check_slo", "load_dump", "load_slos",
+    "load_workload", "parse_exposition", "recorder", "replay_workload",
+    "save_workload", "span", "synthesize_workload", "tracer",
 ]

@@ -1,12 +1,16 @@
 """``python -m deepspeed_tpu.observability`` — render a flight-recorder
-dump as a human-readable timeline summary.
+dump as a human-readable timeline summary, or inspect a workload trace.
 
     python -m deepspeed_tpu.observability /path/flight_1234_fault.json
     python -m deepspeed_tpu.observability --latest /path/to/flight_dir
     python -m deepspeed_tpu.observability dump.json --requests 5
+    python -m deepspeed_tpu.observability workload /path/workload.jsonl
 
-Shows per-request phase timelines (queue → prefill → decode) with duration
-bars, an engine-step summary grouped by step kind, and the infra-event log.
+Flight dumps show per-request phase timelines (queue → prefill → decode)
+with duration bars, an engine-step summary grouped by step kind, and the
+infra-event log.  The ``workload`` subcommand summarizes a captured or
+synthesized workload-trace JSONL (``observability/replay.py`` schema):
+arrival process, prompt/budget distributions, prefix sharing, cancels.
 For interactive digging, load the server's ``GET /debug/trace`` output in
 Perfetto (https://ui.perfetto.dev) instead.
 """
@@ -103,7 +107,62 @@ def render(dump: Dict[str, Any], max_requests: Optional[int] = None) -> str:
     return "\n".join(out)
 
 
+def _workload_main(argv: List[str]) -> int:
+    """``workload`` subcommand: summarize a workload-trace JSONL."""
+    from .replay import load_workload
+
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.observability workload",
+        description="summarize a workload trace "
+                    "(observability/replay.py JSONL schema)")
+    ap.add_argument("trace", help="workload-trace JSONL")
+    ap.add_argument("--prefix_len", type=int, default=8,
+                    help="prefix length for the sharing histogram")
+    args = ap.parse_args(argv)
+
+    meta, reqs = load_workload(args.trace)
+    out: List[str] = []
+    out.append(f"workload {args.trace}")
+    out.append("  meta: " + ", ".join(f"{k}={v}"
+                                      for k, v in sorted(meta.items())))
+    n = len(reqs)
+    dur = reqs[-1].offset_s if n else 0.0
+    out.append(f"  requests: {n}  span: {dur:.3f}s  mean rate: "
+               f"{(n / dur if dur else float('inf')):.2f} req/s")
+    if n:
+        gaps = sorted(reqs[i + 1].offset_s - reqs[i].offset_s
+                      for i in range(n - 1)) or [0.0]
+        out.append(f"  interarrival: min={gaps[0] * 1e3:.1f}ms "
+                   f"p50={gaps[len(gaps) // 2] * 1e3:.1f}ms "
+                   f"max={gaps[-1] * 1e3:.1f}ms")
+        plens = sorted(len(r.prompt) for r in reqs)
+        out.append(f"  prompt tokens: min={plens[0]} "
+                   f"p50={plens[len(plens) // 2]} max={plens[-1]}")
+        budgets = sorted(r.max_new_tokens or 0 for r in reqs)
+        out.append(f"  gen budget: min={budgets[0]} "
+                   f"p50={budgets[len(budgets) // 2]} max={budgets[-1]}")
+        # prefix sharing: how many requests share each distinct k-token
+        # prompt prefix (what a prefix cache would key on)
+        shared: dict = {}
+        for r in reqs:
+            shared.setdefault(tuple(r.prompt[:args.prefix_len]), []
+                              ).append(r)
+        reused = {k: v for k, v in shared.items() if len(v) > 1}
+        out.append(f"  prefix sharing ({args.prefix_len}-token prefixes): "
+                   f"{len(shared)} distinct, {len(reused)} shared by >1 "
+                   f"request, {sum(len(v) for v in reused.values())} "
+                   "requests on shared prefixes")
+        cancels = sum(1 for r in reqs if r.cancel_after_s is not None)
+        deadlines = sum(1 for r in reqs if r.deadline_s is not None)
+        out.append(f"  cancels: {cancels}  deadlines: {deadlines}")
+    print("\n".join(out))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "workload":
+        return _workload_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.observability", description=__doc__)
     ap.add_argument("dump", nargs="?", default=None,

@@ -24,16 +24,19 @@ renders a dump as a human-readable timeline.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from ..utils.logging import logger
 
 _ENV_DIR = "DSTPU_FLIGHT_DIR"
+_ENV_MAX_DUMPS = "DSTPU_FLIGHT_MAX_DUMPS"
+_DEFAULT_MAX_DUMPS = 32
 
 
 class FlightRecorder:
@@ -45,6 +48,7 @@ class FlightRecorder:
         self._requests: Deque[Dict[str, Any]] = deque(maxlen=max_requests)
         self._steps: Deque[Dict[str, Any]] = deque(maxlen=max_steps)
         self._events: Deque[Dict[str, Any]] = deque(maxlen=max_events)
+        self._event_seq = itertools.count(1)
         self._hook_installed = False
 
     # -- recording -------------------------------------------------------
@@ -63,7 +67,42 @@ class FlightRecorder:
     def record_event(self, name: str, **attrs: Any) -> None:
         with self._lock:
             self._events.append({"name": name, "t": time.monotonic(),
-                                 "wall": time.time(), **attrs})
+                                 "wall": time.time(),
+                                 "seq": next(self._event_seq), **attrs})
+
+    # -- cross-process stitching (ISSUE 13) ------------------------------
+
+    def events_since(self, cursor: int,
+                     limit: int = 512) -> Tuple[int, List[Dict[str, Any]]]:
+        """Locally-recorded events with ``seq > cursor`` (ingested remote
+        events are skipped) — the worker side of shipping flight-recorder
+        events to the front over the heartbeat channel."""
+        with self._lock:
+            fresh = [e for e in self._events
+                     if e.get("seq", 0) > cursor and "src_pid" not in e]
+        fresh = fresh[:limit]
+        if not fresh:
+            return cursor, []
+        return fresh[-1]["seq"], [dict(e) for e in fresh]
+
+    def ingest_events(self, events: List[Dict[str, Any]], pid: int) -> int:
+        """Merge a worker's event batch into this ring, tagged with the
+        sender pid and rebased onto this process's monotonic clock via the
+        wall-clock stamp.  Malformed entries are dropped, never raised."""
+        now_m, now_w = time.monotonic(), time.time()
+        n = 0
+        for e in events:
+            try:
+                ev = dict(e)
+                ev["src_pid"] = int(pid)
+                ev["t"] = now_m - (now_w - float(ev.get("wall", now_w)))
+            except (TypeError, ValueError):
+                continue
+            with self._lock:
+                ev["seq"] = next(self._event_seq)
+                self._events.append(ev)
+            n += 1
+        return n
 
     # -- reading / dumping ----------------------------------------------
 
@@ -106,6 +145,7 @@ class FlightRecorder:
             logger.error(f"flight recorder: dumped {len(body['requests'])} "
                          f"request timelines / {len(body['steps'])} steps to "
                          f"{path} (reason: {reason})")
+            _gc_dumps(os.path.dirname(path) or ".")
             return path
         except Exception as e:  # noqa: BLE001 — crash path; never mask
             try:
@@ -130,6 +170,37 @@ class FlightRecorder:
 
     def _crash_dump(self, site: str) -> None:
         self.dump(reason=f"fault_{site.replace('.', '_')}")
+
+
+def _gc_dumps(directory: str) -> None:
+    """Retention GC (ISSUE 13): chaos runs dump one file per worker death,
+    so the flight dir grows without bound.  Keep the newest
+    ``$DSTPU_FLIGHT_MAX_DUMPS`` (default 32) ``flight_*.json`` files and
+    unlink the rest, oldest-first by mtime.  Runs on the dump path, so it
+    must never raise."""
+    try:
+        keep = int(os.environ.get(_ENV_MAX_DUMPS, _DEFAULT_MAX_DUMPS))
+        if keep <= 0:
+            return
+        dumps = []
+        for fn in os.listdir(directory):
+            if fn.startswith("flight_") and fn.endswith(".json"):
+                p = os.path.join(directory, fn)
+                try:
+                    dumps.append((os.path.getmtime(p), p))
+                except OSError:
+                    continue  # concurrent GC from a sibling process
+        dumps.sort()
+        for _, p in dumps[:-keep]:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    except Exception as e:  # noqa: BLE001 — crash path; never mask
+        try:
+            logger.error(f"flight recorder GC failed: {e!r}")
+        except Exception:
+            pass
 
 
 #: process-wide recorder every subsystem records into

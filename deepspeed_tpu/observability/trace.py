@@ -51,6 +51,14 @@ class Span:
     t_end: Optional[float]  # None while open
     attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
     thread: str = ""
+    # cross-process stitching (ISSUE 13): spans ingested from another
+    # process carry that process's pid + display name; local spans leave
+    # both unset.  ``seq`` is the ring-append sequence number — the export
+    # cursor for shipping spans over the heartbeat channel (span_id order
+    # is begin order, but a long-lived span lands in the ring late).
+    pid: Optional[int] = None
+    process: str = ""
+    seq: int = 0
 
     @property
     def duration_s(self) -> float:
@@ -70,6 +78,7 @@ class Tracer:
         self._lock = threading.Lock()
         self._ring: Deque[Span] = deque(maxlen=capacity)
         self._ids = itertools.count(1)
+        self._seq = itertools.count(1)  # ring-append order (export cursor)
         self._local = threading.local()
         # monotonic↔wall anchor so dumps can be mapped to absolute times
         self.mono_zero = time.monotonic()
@@ -123,6 +132,7 @@ class Tracer:
             except ValueError:
                 pass
         with self._lock:
+            sp.seq = next(self._seq)
             self._ring.append(sp)
 
     @contextmanager
@@ -148,6 +158,7 @@ class Tracer:
                   attrs=dict(attrs or {}),
                   thread=threading.current_thread().name)
         with self._lock:
+            sp.seq = next(self._seq)
             self._ring.append(sp)
         return sp
 
@@ -174,6 +185,66 @@ class Tracer:
         with self._lock:
             self._ring.clear()
 
+    # -- cross-process stitching (ISSUE 13) ------------------------------
+    #
+    # Workers ship their completed spans to the front over the heartbeat
+    # channel.  Monotonic clocks are per-process, so the wire format uses
+    # wall-clock endpoints: the sender converts via its own anchors
+    # (``wall = wall_zero + (t - mono_zero)``), the receiver rebases onto
+    # its anchors (``t = mono_zero + (wall - wall_zero)``).  NTP-grade skew
+    # between processes on one host is microseconds — invisible next to
+    # millisecond spans.
+
+    def export_since(self, cursor: int, limit: int = 512) -> tuple:
+        """Locally-recorded spans appended after ``cursor`` (a ring-append
+        ``seq``), as wall-clock wire dicts.  Returns ``(new_cursor,
+        dicts)``; feed ``new_cursor`` back on the next call.  Ingested
+        remote spans are skipped — a front that is itself supervised must
+        not re-export its workers' spans."""
+        with self._lock:
+            fresh = [s for s in self._ring if s.seq > cursor and s.pid is None]
+        fresh.sort(key=lambda s: s.seq)
+        fresh = fresh[:limit]
+        if not fresh:
+            return cursor, []
+        off = self.wall_zero - self.mono_zero
+        out = [{"name": s.name, "trace_id": s.trace_id,
+                "wall_start": s.t_start + off,
+                "wall_end": (s.t_end if s.t_end is not None else s.t_start)
+                + off,
+                "thread": s.thread, "attrs": dict(s.attrs)}
+               for s in fresh]
+        return fresh[-1].seq, out
+
+    def ingest_remote(self, spans: List[Dict[str, Any]], pid: int,
+                      process: str) -> int:
+        """Merge wire dicts from :meth:`export_since` of another process's
+        tracer into this ring, rebased onto this process's monotonic
+        clock and tagged with the sender's pid / display name (they become
+        a separate Perfetto process track).  Returns the count ingested;
+        malformed entries are dropped, never raised — trace ingestion
+        rides the heartbeat path."""
+        if not self.enabled:
+            return 0
+        off = self.mono_zero - self.wall_zero
+        n = 0
+        for d in spans:
+            try:
+                sp = Span(name=str(d["name"]), trace_id=d.get("trace_id"),
+                          span_id=next(self._ids), parent_id=None,
+                          t_start=float(d["wall_start"]) + off,
+                          t_end=float(d["wall_end"]) + off,
+                          attrs=dict(d.get("attrs") or {}),
+                          thread=str(d.get("thread") or "main"),
+                          pid=int(pid), process=process)
+            except (KeyError, TypeError, ValueError):
+                continue
+            with self._lock:
+                sp.seq = next(self._seq)
+                self._ring.append(sp)
+            n += 1
+        return n
+
     # -- export ----------------------------------------------------------
 
     def to_chrome_trace(self, spans: Optional[List[Span]] = None) -> dict:
@@ -184,16 +255,30 @@ class Tracer:
         if spans is None:
             spans = self.spans()
         pid = os.getpid()
+        # one process_name metadata event per distinct pid: the local
+        # process first, then every remote process seen in the spans —
+        # Perfetto renders each as its own track group, which is what makes
+        # a stitched fleet trace readable as front + workers.
         events = [{
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
             "args": {"name": "deepspeed_tpu"},
         }]
+        named = {pid}
+        for s in spans:
+            if s.pid is not None and s.pid not in named:
+                named.add(s.pid)
+                events.append({
+                    "name": "process_name", "ph": "M", "pid": s.pid,
+                    "tid": 0, "args": {"name": s.process
+                                       or f"worker-{s.pid}"}})
         for s in spans:
             ts = (s.t_start - self.mono_zero) * 1e6
             args = {k: v for k, v in s.attrs.items()}
             if s.trace_id is not None:
                 args["trace_id"] = s.trace_id
-            base = {"name": s.name, "pid": pid, "tid": s.thread or "main",
+            base = {"name": s.name, "pid": (s.pid if s.pid is not None
+                                            else pid),
+                    "tid": s.thread or "main",
                     "ts": ts, "cat": (s.trace_id or "infra"), "args": args}
             if s.t_end is None or s.t_end == s.t_start:
                 events.append({**base, "ph": "i", "s": "t"})

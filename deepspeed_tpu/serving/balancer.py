@@ -117,13 +117,16 @@ class BalancedHandle:
                 request_logger(self._handle.rid).warning(
                     f"serving: retrying after {e.reason} "
                     f"(attempt {attempts}, backoff {sleep_s * 1e3:.0f}ms)")
+                trace_id = self._kwargs.get("trace_id") or self._handle.rid
                 tracer.add_event("request/failover",
-                                 trace_id=self._handle.rid,
+                                 trace_id=trace_id,
                                  attrs={"reason": e.reason,
                                         "attempt": attempts,
+                                        "rid": self._handle.rid,
                                         "from_replica": self.replica_index})
                 recorder.record_event("request/failover",
-                                      rid=self._handle.rid, reason=e.reason,
+                                      rid=self._handle.rid,
+                                      trace_id=trace_id, reason=e.reason,
                                       attempt=attempts,
                                       from_replica=self.replica_index)
                 self._handle, self.replica_index = \
@@ -316,6 +319,11 @@ class ReplicaPool:
             raise NoReplicaError("pool not accepting (draining/stopped)")
         kwargs = dict(kwargs, prompt=list(prompt))
         handle, idx = self._resubmit(kwargs, fresh=True)
+        # pin the trace identity to the first placement's rid: a failover
+        # resubmit mints a new rid on the new replica but keeps this
+        # trace_id, so the stitched /debug/trace shows one continuous
+        # request timeline across both workers (ISSUE 13)
+        kwargs.setdefault("trace_id", handle.rid)
         return BalancedHandle(self, handle, idx, kwargs)
 
     def _resubmit(self, kwargs: dict, fresh: bool = False):
@@ -430,12 +438,16 @@ class ReplicaPool:
                                 sum(kv) / len(kv) if kv else 0.0)
         self.metrics.set_prefix_stats(self._aggregate_prefix_stats())
         self.metrics.set_spec_stats(self._aggregate_spec_stats())
+        # a dead replica's stats accessors return last-known (frozen)
+        # values: mark its gauge series stale so dashboards can tell
+        # frozen-but-reported from live (ISSUE 13 satellite)
         self.metrics.set_replica_stats([
             {"name": t.name, "healthy": float(t.healthy()),
              "queue_depth": float(t.queue_depth()),
              "running": float(t.num_running()),
              "outstanding_tokens": float(t.outstanding_tokens()),
-             "kv_utilization": t.kv_utilization()}
+             "kv_utilization": t.kv_utilization(),
+             "stale": not t.healthy()}
             for t in self.replicas])
 
     def _pump_loop(self) -> None:

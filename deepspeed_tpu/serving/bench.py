@@ -412,6 +412,123 @@ def run_spec_sweep(rates: List[float], duration_s: float = 6.0,
     }
 
 
+# -- trace-driven replay with SLO gates (ISSUE 13) -------------------------
+
+
+def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
+               requests: int = 24, rate_rps: float = 8.0,
+               cancel_fraction: float = 0.0,
+               transport: str = "inprocess", replicas: int = 2,
+               time_scale: float = 1.0, chaos: Optional[str] = None,
+               slo_path: Optional[str] = None,
+               slo_workload: Optional[str] = None,
+               model: str = "tiny", max_queue: int = 64,
+               save_trace: Optional[str] = None) -> dict:
+    """Replay a workload trace (recorded JSONL or seeded synthesis) against
+    a fresh replica pool — driven at the pool, not over HTTP, so the same
+    seed reproduces arrival schedule AND token streams exactly — then gate
+    the TTFT/TPOT/goodput/queue-depth summary against ``slo.toml``.
+
+    The result carries ``slo_violations`` (named-key diffs); ``main``
+    turns a non-empty list into a nonzero exit."""
+    import argparse
+
+    from ..observability import replay as rp
+    from .balancer import ReplicaPool
+    from .config import ServingConfig
+    from .server import (add_engine_cli_args, add_serving_cli_args,
+                         build_engine_factory, engine_argv_from_args,
+                         serving_argv_from_config)
+
+    if workload_trace:
+        meta, wl = rp.load_workload(workload_trace)
+        slo_workload = slo_workload or "replay-default"
+    else:
+        meta, wl = rp.synthesize_workload(seed=seed, num_requests=requests,
+                                          mean_rate_rps=rate_rps,
+                                          cancel_fraction=cancel_fraction)
+        slo_workload = slo_workload or "synthetic-smoke"
+    if save_trace:
+        rp.save_workload(save_trace, wl, meta)
+    slos = rp.load_slos(slo_path)
+    if slo_workload not in slos:
+        raise rp.SLOError(f"no [workloads.\"{slo_workload}\"] table in "
+                          f"{slo_path or rp.default_slo_path()}; have "
+                          f"{sorted(slos)}")
+
+    # small fixed engine geometry: big enough for the synthetic prompts
+    # (16 tok) + budgets (≤8 tok), small enough to compile fast on CPU
+    ep = argparse.ArgumentParser()
+    add_engine_cli_args(ep)
+    add_serving_cli_args(ep)
+    eargs = ep.parse_args([
+        "--model", model, "--seed", "0", "--num_blocks", "64",
+        "--max_tokens_per_step", "32", "--max_seqs", "4",
+        "--block_size", "8", "--max_blocks_per_seq", "8",
+        "--max_queue", str(max_queue)])
+    cfg = ServingConfig(max_queue=max_queue, num_replicas=replicas,
+                        replica_transport=transport,
+                        heartbeat_interval_s=0.2, heartbeat_timeout_s=2.0,
+                        respawn_backoff_s=0.2, submit_timeout_s=120.0,
+                        spawn_timeout_s=300.0)
+    if transport == "subprocess":
+        worker_argv = (engine_argv_from_args(eargs)
+                       + serving_argv_from_config(cfg))
+        pool = ReplicaPool.build_subprocess(worker_argv, cfg)
+    else:
+        pool = ReplicaPool.build(build_engine_factory(eargs), cfg)
+    pool.start()
+    pool.wait_ready()
+    leaked_blocks = leaked_procs = 0
+    try:
+        # warm the compile caches (one concurrent request per replica:
+        # least-outstanding routing spreads them) so the replay's TTFT
+        # percentiles measure serving, not first-touch XLA compiles
+        warm = [pool.submit([1, 2, 3], max_new_tokens=2)
+                for _ in range(replicas)]
+        for h in warm:
+            h.result(timeout=300)
+        out = rp.replay_workload(pool, wl, time_scale=time_scale,
+                                 chaos=rp.parse_chaos(chaos))
+        # post-replay leak check while the pool is still up: any pinned KV
+        # blocks left once nothing is running is a leak
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sum(t.num_running() for t in pool.replicas
+                   if t.healthy()) == 0 and pool.queue_depth() == 0:
+                break
+            time.sleep(0.2)
+        leaked_blocks = int(sum(
+            t.prefix_stats().get("pinned_blocks", 0)
+            for t in pool.replicas if t.healthy()))
+    finally:
+        pool.drain()
+    if transport == "subprocess":
+        leaked_procs = sum(
+            1 for t in pool.replicas
+            if getattr(t, "_proc", None) is not None
+            and t._proc.poll() is None)
+    summary = out["summary"]
+    violations = rp.check_slo(summary, slos[slo_workload], slo_workload)
+    return {
+        "subject": f"{model} model, JAX_PLATFORMS=cpu, open-loop replay "
+                   f"driven at the ReplicaPool ({transport}, "
+                   f"{replicas} replicas)",
+        "workload_meta": meta,
+        "time_scale": time_scale,
+        "chaos": chaos or None,
+        "slo_workload": slo_workload,
+        "summary": summary,
+        "leaked_blocks_after_idle": leaked_blocks,
+        "leaked_worker_processes_after_drain": leaked_procs,
+        "slo_violations": [v.to_dict() for v in violations],
+        "outcomes": {
+            r["outcome"]: sum(1 for q in out["requests"]
+                              if q["outcome"] == r["outcome"])
+            for r in out["requests"]},
+    }
+
+
 # -- mixed-GEMM kernel microbench ------------------------------------------
 
 
@@ -517,7 +634,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dstpu-serving-bench")
     p.add_argument("--out", default=None,
                    help="merge results into this BENCH_EVIDENCE.json")
-    p.add_argument("--mode", choices=["serving", "prefix", "spec", "gemm"],
+    p.add_argument("--mode",
+                   choices=["serving", "prefix", "spec", "gemm", "replay"],
                    default="serving")
     p.add_argument("--rates", default="2,8,24")
     p.add_argument("--duration_s", type=float, default=8.0)
@@ -533,10 +651,43 @@ def main(argv=None) -> int:
     p.add_argument("--gemm_iters", type=int, default=3)
     p.add_argument("--tune_tiles", action="store_true",
                    help="run the measured tile search per gemm cell")
+    p.add_argument("--workload_trace", default=None,
+                   help="replay: recorded workload JSONL (default: seeded "
+                        "synthesis)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="replay: synthesis seed")
+    p.add_argument("--requests", type=int, default=24,
+                   help="replay: synthesized request count")
+    p.add_argument("--cancel_fraction", type=float, default=0.0,
+                   help="replay: synthesized cancel fraction")
+    p.add_argument("--transport", choices=["inprocess", "subprocess"],
+                   default="inprocess", help="replay: replica transport")
+    p.add_argument("--time_scale", type=float, default=1.0,
+                   help="replay: arrival-schedule scale (0.5 = 2x faster)")
+    p.add_argument("--chaos", default=None,
+                   help="replay: chaos schedule, comma-separated "
+                        "AT_S:REPLICA:SITE=KIND[;SITE=KIND] events")
+    p.add_argument("--slo", default=None,
+                   help="replay: slo.toml path (default: the packaged one)")
+    p.add_argument("--slo_workload", default=None,
+                   help="replay: [workloads.\"<name>\"] table to gate "
+                        "against")
+    p.add_argument("--save_trace", default=None,
+                   help="replay: also save the replayed workload as JSONL")
     args = p.parse_args(argv)
 
     rates = [float(r) for r in args.rates.split(",")]
-    if args.mode == "gemm":
+    if args.mode == "replay":
+        result = run_replay(
+            workload_trace=args.workload_trace, seed=args.seed,
+            requests=args.requests, rate_rps=rates[0],
+            cancel_fraction=args.cancel_fraction, transport=args.transport,
+            replicas=args.replicas or 2, time_scale=args.time_scale,
+            chaos=args.chaos, slo_path=args.slo,
+            slo_workload=args.slo_workload,
+            max_queue=args.max_queue or 64, save_trace=args.save_trace)
+        key = "replay"
+    elif args.mode == "gemm":
         result = run_gemm_sweep(
             ms=tuple(int(m) for m in args.gemm_ms.split(",")),
             bits_list=tuple(int(b) for b in args.gemm_bits.split(",")),
@@ -570,6 +721,11 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             json.dump(evidence, f, indent=1)
             f.write("\n")
+    if args.mode == "replay" and result["slo_violations"]:
+        for v in result["slo_violations"]:
+            print(f"SLO VIOLATION: [{v['workload']}] {v['check']}: "
+                  f"actual {v['actual']} violates SLO {v['limit']}")
+        return 1
     return 0
 
 

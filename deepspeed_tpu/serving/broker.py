@@ -33,6 +33,7 @@ from collections import deque
 from typing import Deque, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..inference.v2.engine import AdmissionError, InferenceEngineV2
+from ..observability import replay as workload
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
 from ..utils import faults
@@ -93,6 +94,11 @@ class _Request:
     finish_reason: Optional[str] = None
     error: Optional[str] = None
     out_q: "queue.Queue" = dataclasses.field(default_factory=queue.Queue)
+    # fleet-wide trace identity (ISSUE 13): the trace id minted by the
+    # FIRST process that saw the request.  A failover resubmit mints a new
+    # rid on the new replica but keeps the original trace_id, so the
+    # stitched timeline shows one request across two workers.
+    trace_id: Optional[str] = None
 
 
 class RequestHandle:
@@ -175,7 +181,8 @@ class RequestBroker:
                temperature: Optional[float] = None,
                deadline_s: Optional[float] = None,
                stop_token_ids: Sequence[int] = (),
-               rid: Optional[str] = None) -> RequestHandle:
+               rid: Optional[str] = None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise InvalidRequestError("prompt must be a non-empty token list")
@@ -206,6 +213,7 @@ class RequestBroker:
                 int(t) for t in stop_token_ids),
             deadline=None if deadline_s is None else now + deadline_s,
             submit_ts=now)
+        req.trace_id = trace_id or req.rid
         with self._wake:
             if self._stop or self._dead:
                 raise BrokerStoppedError(f"broker {self.name} not accepting")
@@ -217,10 +225,14 @@ class RequestBroker:
             self._queue.append(req)
             self._by_rid[req.rid] = req
             self._wake.notify_all()
-        tracer.add_event("request/submit", trace_id=req.rid,
-                         attrs={"replica": self.name,
+        tracer.add_event("request/submit", trace_id=req.trace_id,
+                         attrs={"replica": self.name, "rid": req.rid,
                                 "prompt_tokens": len(prompt),
                                 "max_new_tokens": mnt})
+        workload.note_submit(rid=req.rid, t=now, prompt=prompt,
+                             max_new_tokens=mnt,
+                             stop_token_ids=[int(t) for t in stop_token_ids],
+                             deadline_s=deadline_s)
         request_logger(req.rid).info(
             f"serving: submitted to {self.name} "
             f"(prompt={len(prompt)} tok, budget={mnt})")
@@ -236,6 +248,7 @@ class RequestBroker:
                 self._apply_cancels_locked()  # paused/dead broker
             else:
                 self._wake.notify_all()
+        workload.note_cancel(rid, time.monotonic())
         return True
 
     # -- pool surface ----------------------------------------------------
@@ -373,17 +386,19 @@ class RequestBroker:
                 spans.append(("request/prefill", req.admit_ts, req.finish_ts))
         else:  # never admitted: the whole life was queueing
             spans.append(("request/queue", req.submit_ts, req.finish_ts))
+        tid = req.trace_id or req.rid
         root = tracer.add_span(
-            "request", req.submit_ts, req.finish_ts, trace_id=req.rid,
-            attrs={"replica": self.name, "uid": req.uid,
+            "request", req.submit_ts, req.finish_ts, trace_id=tid,
+            attrs={"replica": self.name, "uid": req.uid, "rid": req.rid,
                    "reason": req.finish_reason, "tokens_out": req.delivered})
         parent = root.span_id if root is not None else None
         for name, t0, t1 in spans:
-            tracer.add_span(name, t0, t1, trace_id=req.rid, parent_id=parent)
+            tracer.add_span(name, t0, t1, trace_id=tid, parent_id=parent)
         ttft_ms = (None if req.first_token_ts is None
                    else (req.first_token_ts - req.submit_ts) * 1e3)
         recorder.record_request({
-            "rid": req.rid, "uid": req.uid, "replica": self.name,
+            "rid": req.rid, "trace_id": req.trace_id,
+            "uid": req.uid, "replica": self.name,
             "submit_ts": req.submit_ts, "admit_ts": req.admit_ts,
             "first_token_ts": req.first_token_ts, "finish_ts": req.finish_ts,
             "finish_reason": req.finish_reason, "tokens_out": req.delivered,

@@ -345,12 +345,22 @@ class ServingMetrics:
                       _FLEET_HELP.get(k, f"Fleet: {k.replace('_', ' ')}."),
                       snap[f"replica_{k}"])
         if replica_stats:
-            keys = [k for k in replica_stats[0] if k != "name"]
+            # "stale" is a label, not a gauge: a dead replica's series keep
+            # their last-known values but carry stale="true" so dashboards
+            # can tell frozen-but-reported from live (ISSUE 13 satellite)
+            def _labels(s, i):
+                labels = {"replica": str(s.get("name", i))}
+                if s.get("stale"):
+                    labels["stale"] = "true"
+                return labels
+
+            keys = [k for k in replica_stats[0]
+                    if k not in ("name", "stale")]
             for k in keys:
                 b.gauge_series(
                     f"{pre}replica_{k}",
                     f"Per-replica {k.replace('_', ' ')}.",
-                    [({"replica": str(s.get("name", i))}, float(s[k]))
+                    [(_labels(s, i), float(s.get(k, 0.0)))
                      for i, s in enumerate(replica_stats)])
         return b.render()
 

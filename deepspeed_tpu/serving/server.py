@@ -84,6 +84,10 @@ class ServingHTTPServer(ThreadingHTTPServer):
         self.decode = decode or _default_decode
         self._handles = {}  # rid -> BalancedHandle (live requests)
         self._handles_lock = threading.Lock()
+        # /debug/profile serialization: jax.profiler.trace is process-wide
+        # and not reentrant — a second overlapping capture must get a clean
+        # 409, not a mid-capture crash (ISSUE 13 satellite)
+        self.profile_lock = threading.Lock()
 
     def handle_error(self, request, client_address):  # noqa: N802
         import sys as _sys
@@ -193,14 +197,23 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, "seconds must be in (0, 60]",
                         "invalid_request_error")
             return
-        out_dir = tempfile.mkdtemp(prefix="dstpu_profile_")
-        try:
-            with tracer.span("debug/profile", seconds=seconds):
-                with jax.profiler.trace(out_dir):
-                    time.sleep(seconds)
-        except Exception as e:  # profiler unavailable on this backend
-            self._error(503, f"profiler failed: {e!r}", "profiler_error")
+        if not self.server.profile_lock.acquire(blocking=False):
+            # jax.profiler.trace is process-wide: an overlapping second
+            # capture would die inside the profiler with an opaque 503
+            self._error(409, "profiler busy: a capture is already running",
+                        "profiler_busy")
             return
+        try:
+            out_dir = tempfile.mkdtemp(prefix="dstpu_profile_")
+            try:
+                with tracer.span("debug/profile", seconds=seconds):
+                    with jax.profiler.trace(out_dir):
+                        time.sleep(seconds)
+            except Exception as e:  # profiler unavailable on this backend
+                self._error(503, f"profiler failed: {e!r}", "profiler_error")
+                return
+        finally:
+            self.server.profile_lock.release()
         self._json(200, {"profile_dir": out_dir, "seconds": seconds})
 
     def do_POST(self):  # noqa: N802

@@ -133,6 +133,8 @@ class ReplicaSupervisor:
         tracer.add_event(f"replica/{reason}",
                          attrs={"replica": r.name,
                                 "generation": r.generation, **attrs})
+        recorder.record_event(f"replica/{reason}", replica=r.name,
+                              generation=r.generation, **attrs)
         r.mark_down(reason)
 
     def _maybe_respawn(self, r: SubprocessReplica) -> None:

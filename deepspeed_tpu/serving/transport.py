@@ -394,6 +394,20 @@ class SubprocessReplica(ReplicaTransport):
             with self._lock:
                 self._last_hb = time.monotonic()
                 self._stats = frame.get("stats", {})
+            # trace stitching (ISSUE 13): heartbeats piggyback the worker's
+            # freshly-completed spans and flight-recorder events; merge
+            # them into THIS process's rings so /debug/trace and flight
+            # dumps show the whole fleet.  Outside the transport lock —
+            # ingestion takes the tracer/recorder locks.
+            spans = frame.get("spans") or []
+            events = frame.get("events") or []
+            if spans or events:
+                pid = int(frame.get("pid") or 0)
+                proc_name = frame.get("proc") or f"worker-{self.name}"
+                if spans:
+                    tracer.ingest_remote(spans, pid, proc_name)
+                if events:
+                    recorder.ingest_events(events, pid)
             return
         rid = frame.get("rid")
         if ev in ("accepted", "rejected"):
@@ -553,6 +567,15 @@ class SubprocessReplica(ReplicaTransport):
             if kwargs.get(key) is not None:
                 msg[key] = kwargs[key] if key != "stop_token_ids" \
                     else list(kwargs[key])
+        # trace context (ISSUE 13): the worker's broker records its spans
+        # under the trace id minted by the FIRST process that saw the
+        # request, so a failover resubmit (new rid, same trace_id) still
+        # renders as one continuous request timeline.
+        trace_id = kwargs.get("trace_id") or rid
+        msg["trace"] = {"trace_id": trace_id}
+        tracer.add_event("request/dispatch", trace_id=trace_id,
+                         attrs={"replica": self.name, "rid": rid,
+                                "generation": self.generation})
         try:
             send_frame(sock, msg, self._wlock)
             ack = ack_q.get(timeout=self.cfg.submit_timeout_s)

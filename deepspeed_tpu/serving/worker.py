@@ -38,6 +38,7 @@ Chaos sites (``utils/faults``), all reachable via the parent's
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import socket
 import sys
@@ -45,6 +46,7 @@ import threading
 from typing import Optional
 
 from ..observability.recorder import recorder
+from ..observability.trace import tracer
 from ..utils import faults
 from ..utils.logging import logger
 from .broker import (BrokerStoppedError, InvalidRequestError, QueueFullError,
@@ -87,15 +89,43 @@ def _pump(conn: socket.socket, wlock: threading.Lock, rid: str,
         handle.cancel()
 
 
+class _HeartbeatState:
+    """Cursors for the span / flight-event batches piggybacked on
+    heartbeat frames (ISSUE 13 trace stitching).  One instance per worker
+    connection; the final graceful-stop flush shares it with the
+    heartbeat thread, so frame building is serialized."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.pid = os.getpid()
+        self.span_cursor = 0
+        self.event_cursor = 0
+        self._lock = threading.Lock()
+
+    def frame(self, broker: RequestBroker) -> dict:
+        hb = {"ev": "hb", "stats": _stats(broker),
+              "pid": self.pid, "proc": self.name}
+        with self._lock:
+            self.span_cursor, spans = tracer.export_since(self.span_cursor)
+            self.event_cursor, events = recorder.events_since(
+                self.event_cursor)
+        if spans:
+            hb["spans"] = spans
+        if events:
+            hb["events"] = events
+        return hb
+
+
 def _heartbeat_loop(conn: socket.socket, wlock: threading.Lock,
                     broker: RequestBroker, interval_s: float,
-                    stop_evt: threading.Event) -> None:
+                    stop_evt: threading.Event,
+                    hb_state: _HeartbeatState) -> None:
     while not stop_evt.wait(interval_s):
         faults.maybe_fail("serving.worker.hardkill")
         faults.maybe_fail("serving.worker.hang")
         faults.maybe_fail("serving.worker.heartbeat")
         try:
-            send_frame(conn, {"ev": "hb", "stats": _stats(broker)}, wlock)
+            send_frame(conn, hb_state.frame(broker), wlock)
         except OSError:
             return  # parent gone; the reader loop handles shutdown
 
@@ -166,9 +196,11 @@ def main(argv: Optional[list] = None) -> int:
             pass
 
     signal.signal(signal.SIGTERM, _sigterm)
+    hb_state = _HeartbeatState(args.name)
     threading.Thread(
         target=_heartbeat_loop,
-        args=(conn, wlock, broker, args.heartbeat_interval_s, stop_evt),
+        args=(conn, wlock, broker, args.heartbeat_interval_s, stop_evt,
+              hb_state),
         name="dstpu-worker-hb", daemon=True).start()
     logger.info(f"worker {args.name}: serving on {host}:{port}")
 
@@ -183,6 +215,7 @@ def main(argv: Optional[list] = None) -> int:
         op = frame.get("op")
         if op == "submit":
             rid = frame["rid"]
+            trace_ctx = frame.get("trace") or {}
             try:
                 handle = broker.submit(
                     prompt=frame["prompt"],
@@ -190,7 +223,8 @@ def main(argv: Optional[list] = None) -> int:
                     temperature=frame.get("temperature"),
                     deadline_s=frame.get("deadline_s"),
                     stop_token_ids=frame.get("stop_token_ids", ()),
-                    rid=rid)
+                    rid=rid,
+                    trace_id=trace_ctx.get("trace_id"))
             except QueueFullError as e:
                 send_frame(conn, {"ev": "rejected", "rid": rid,
                                   "etype": "queue_full", "detail": str(e)},
@@ -225,6 +259,12 @@ def main(argv: Optional[list] = None) -> int:
 
     stop_evt.set()
     broker.stop(**drain_on_stop)
+    # final span/event flush: drained requests finalize during stop(), and
+    # their timelines must reach the front before the socket closes
+    try:
+        send_frame(conn, hb_state.frame(broker), wlock)
+    except OSError:
+        pass
     try:
         conn.close()
     except OSError:

@@ -112,6 +112,18 @@ then
     exit 2
 fi
 
+# replay suite: imports the workload capture/synthesis/replay harness
+# (observability/replay.py), the packaged slo.toml gate, and the
+# bench --mode replay plumbing over both transports
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_replay.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_replay.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 # mixed-GEMM path suite: imports the Pallas kernel wiring (linear/ frozen
 # base, models/ scan path, inference/v2 quantized serving)
 if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \

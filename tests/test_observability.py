@@ -174,6 +174,31 @@ def test_recorder_dump_without_destination_is_none(monkeypatch):
     assert FlightRecorder().dump() is None  # no env, no path → no scatter
 
 
+def test_dump_gc_keeps_newest(tmp_path, monkeypatch):
+    """Dump-time GC: a crash-looping worker must not fill the disk — only
+    the newest $DSTPU_FLIGHT_MAX_DUMPS flight_*.json survive."""
+    monkeypatch.setenv("DSTPU_FLIGHT_MAX_DUMPS", "3")
+    rec = FlightRecorder()
+    rec.record_event("ev")
+    paths = []
+    for i in range(6):
+        p = str(tmp_path / f"flight_{i}.json")
+        rec.dump(path=p, reason=f"r{i}")
+        os.utime(p, (i + 1, i + 1))  # deterministic mtime order
+        paths.append(p)
+    survivors = sorted(f for f in os.listdir(tmp_path)
+                       if f.startswith("flight_"))
+    assert survivors == ["flight_3.json", "flight_4.json", "flight_5.json"]
+    # unrelated files are never touched, and GC failures never raise
+    (tmp_path / "notes.txt").write_text("keep me")
+    rec.dump(path=str(tmp_path / "flight_7.json"), reason="r7")
+    assert (tmp_path / "notes.txt").exists()
+    monkeypatch.setenv("DSTPU_FLIGHT_MAX_DUMPS", "0")  # 0 disables GC
+    rec.dump(path=str(tmp_path / "flight_8.json"), reason="r8")
+    assert len([f for f in os.listdir(tmp_path)
+                if f.startswith("flight_")]) == 4
+
+
 # ---------------------------------------------------------------------------
 # prometheus exposition: builder + strict parser
 # ---------------------------------------------------------------------------
@@ -298,6 +323,25 @@ def test_metrics_exposition_is_strictly_valid():
     tpot = dict((s[0], s[2]) for s in fams["dstpu_serving_tpot_ms"]["samples"]
                 if s[0].endswith("_count"))
     assert tpot["dstpu_serving_tpot_ms_count"] == 5
+
+
+def test_replica_gauges_carry_stale_label_for_dead_replicas():
+    """A dead replica's stats accessors return last-known (frozen) values;
+    its gauge series must say so via stale="true" instead of passing the
+    frozen numbers off as live (ISSUE 13 satellite)."""
+    m = ServingMetrics()
+    m.set_replica_stats([
+        {"name": "replica0", "healthy": 1.0, "queue_depth": 1.0,
+         "stale": False},
+        {"name": "replica1", "healthy": 0.0, "queue_depth": 3.0,
+         "stale": True}])
+    fams = parse_exposition(m.to_prometheus())  # mixed label sets parse
+    by_replica = {lbl["replica"]: lbl for _, lbl, _ in
+                  fams["dstpu_serving_replica_queue_depth"]["samples"]}
+    assert "stale" not in by_replica["replica0"]
+    assert by_replica["replica1"]["stale"] == "true"
+    # "stale" is a label, never a gauge family of its own
+    assert "dstpu_serving_replica_stale" not in fams
 
 
 # ---------------------------------------------------------------------------
@@ -517,6 +561,25 @@ def test_debug_endpoints_and_metrics_e2e(http_stack):
         assert os.path.isdir(prof["profile_dir"])
     else:
         assert resp.status == 503
+
+
+def test_profile_endpoint_409_when_capture_in_flight(http_stack):
+    """jax.profiler.trace is process-wide and not reentrant: a second
+    overlapping /debug/profile must get a clean 409, never a mid-capture
+    crash (ISSUE 13 satellite)."""
+    srv, _pool_, port = http_stack
+    assert srv.profile_lock.acquire(blocking=False)  # simulate a capture
+    try:
+        resp, body = _get(port, "/debug/profile?seconds=0.1")
+        assert resp.status == 409
+        err = json.loads(body)["error"]
+        assert err["type"] == "profiler_busy"
+        assert "busy" in err["message"]
+    finally:
+        srv.profile_lock.release()
+    # bad-arg validation still runs before the lock is consulted
+    resp, _ = _get(port, "/debug/profile?seconds=999")
+    assert resp.status == 400
 
 
 # ---------------------------------------------------------------------------
