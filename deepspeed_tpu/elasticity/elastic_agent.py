@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from ..launcher.runner import DEFAULT_COORDINATOR_PORT
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
+from ..utils.backoff import exponential_backoff
 from ..utils.logging import logger
 from ..utils.proc import terminate_procs
 from .elasticity import ElasticityConfig, compute_elastic_config
@@ -173,10 +174,9 @@ class ElasticAgent:
                 f"elastic agent: checkpoints exist under {ckpt_dir} but NONE "
                 "validate — workers start fresh; backing off before launch")
             if self.restart_count > 0:
-                delay = min(
-                    self.cfg.restart_backoff_s * 2 ** (self.restart_count - 1),
-                    self.cfg.restart_backoff_max_s)
-                time.sleep(delay)
+                time.sleep(exponential_backoff(self.cfg.restart_backoff_s,
+                                               self.cfg.restart_backoff_max_s,
+                                               self.restart_count))
         return {}
 
     def _start_group(self, members: List[str]) -> None:

@@ -513,6 +513,37 @@ class InferenceEngineV2:
             self._spec_fwd = build_draft_spec_step(
                 self.model_cfg, self.draft_cfg, self.cfg)
 
+    # -- rolling weight swaps (serving/rollout.py) ----------------------
+
+    def swap_params(self, raw_params: Any) -> None:
+        """Point the engine at a new param pytree (rolling weight swap).
+        ``raw_params`` is the UNQUANTIZED checkpoint tree; the engine
+        re-applies its own quantization config so a quantized deployment
+        swaps into quantized weights.  The previous tree is retained for
+        :meth:`swap_rollback`.  Safe only between steps on a drained
+        engine: the jitted forwards take params as call arguments, so
+        the swap is a pointer move, but swapping mid-request would mix
+        weight generations within one stream."""
+        if self.cfg.quantize_bits:
+            from ..quantization import quantize_on_host
+
+            raw_params = quantize_on_host(raw_params, self.cfg.quantize_bits,
+                                          self.cfg.quantize_group)
+        if (jax.tree_util.tree_structure(raw_params)
+                != jax.tree_util.tree_structure(self.params)):
+            raise ValueError("swap_params: incoming pytree structure does "
+                             "not match the serving model")
+        self._prev_params = self.params
+        self.params = raw_params
+
+    def swap_rollback(self) -> None:
+        """Restore the pre-swap weights (failed post-swap probe)."""
+        prev = getattr(self, "_prev_params", None)
+        if prev is None:
+            raise RuntimeError("swap_rollback: no previous params retained")
+        self.params = prev
+        self._prev_params = None
+
     # -- capacity accessors (serving metrics / admission control) -------
     @property
     def total_blocks(self) -> int:

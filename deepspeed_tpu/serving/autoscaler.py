@@ -1,0 +1,185 @@
+"""Goodput-driven autoscaler: grow and shrink the remote fleet.
+
+The serving-side sibling of the elastic agent's membership loop
+(``elasticity/elastic_agent.py``): a single control thread samples fleet
+**pressure** every ``autoscale_interval_s`` and converges the healthy
+replica count into ``[autoscale_min, autoscale_max]``.
+
+Pressure is ``(queued requests + outstanding generation tokens) /
+healthy replicas`` — the per-replica backlog measured in the unit that
+actually costs decode steps, not request count.  Decisions:
+
+* **floor** — healthy count below ``autoscale_min`` → spawn immediately
+  (no debounce: the floor is an availability promise, not an
+  optimization).
+* **scale up** — pressure above ``scale_up_pressure`` sustained for
+  ``scale_up_debounce_s`` → spawn one slot, then cool down one debounce
+  window before growing again (a cold worker pays JAX import + compile
+  before it absorbs load; spawning more during that window overshoots).
+  At ``autoscale_max`` a hot fleet records ``autoscale_blocked`` once
+  per hot episode instead.
+* **scale down** — pressure below ``scale_down_pressure`` sustained for
+  ``scale_down_idle_s`` and count above the floor → quiesce the
+  highest-index replica, drain it (zero-drop), retire it.
+* **ban** — ``autoscale_max_spawn_fails`` consecutive spawn failures
+  bans growth (elastic-agent ban discipline for flapping hosts), with
+  exponential backoff between strikes; one successful spawn clears the
+  strikes.
+
+Every decision lands in the tracer, the flight recorder, and the
+``dstpu_serving_autoscale_{up,down,blocked}`` counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..observability.recorder import recorder
+from ..observability.trace import tracer
+from ..utils.backoff import exponential_backoff
+from ..utils.logging import logger
+from .config import ServingConfig
+from .metrics import ServingMetrics
+
+
+class Autoscaler:
+    """Control loop over a remote :class:`~deepspeed_tpu.serving.balancer.
+    ReplicaPool`: spawn via ``pool.spawn_remote_replica``, retire via
+    ``pool.retire_replica``."""
+
+    def __init__(self, pool, config: ServingConfig,
+                 metrics: Optional[ServingMetrics] = None):
+        self.pool = pool
+        self.cfg = config
+        self.metrics = metrics or pool.metrics
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # debounce state
+        self._hot_since: Optional[float] = None
+        self._cold_since: Optional[float] = None
+        self._blocked_noted = False
+        self._cooldown_until = 0.0
+        # ban discipline
+        self._spawn_fails = 0
+        self.banned = False
+        #: decision mirror for quick assertions/bench reporting
+        self.decisions = {"up": 0, "down": 0, "blocked": 0}
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            return self
+        self.pool.autoscaler = self
+        self._thread = threading.Thread(target=self._run,
+                                        name="dstpu-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.autoscale_interval_s):
+            try:
+                self._tick()
+            except Exception as e:  # noqa: BLE001 — the control loop must
+                # outlive any single bad decision
+                logger.error(f"autoscaler: tick failed: {e!r}")
+
+    # -- control law -----------------------------------------------------
+
+    def pressure(self) -> float:
+        n = len(self.pool.healthy_replicas())
+        backlog = self.pool.queue_depth() + sum(
+            t.outstanding_tokens() for t in self.pool.replicas)
+        return backlog / max(1, n)
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        n = len(self.pool.healthy_replicas())
+        p = self.pressure()
+
+        if n < self.cfg.autoscale_min:
+            # availability floor: restore immediately (no debounce)
+            self._scale_up(now, n, p, reason="below_min")
+            return
+
+        if p > self.cfg.scale_up_pressure:
+            self._cold_since = None
+            if self._hot_since is None:
+                self._hot_since = now
+            if now - self._hot_since < self.cfg.scale_up_debounce_s:
+                return
+            if self.cfg.autoscale_max and n >= self.cfg.autoscale_max:
+                if not self._blocked_noted:
+                    self._blocked_noted = True
+                    self._record("blocked", n=n, pressure=p)
+                return
+            if self.banned or now < self._cooldown_until:
+                return
+            self._scale_up(now, n, p, reason="pressure")
+            return
+
+        self._hot_since = None
+        self._blocked_noted = False
+
+        if p < self.cfg.scale_down_pressure and n > self.cfg.autoscale_min:
+            if self._cold_since is None:
+                self._cold_since = now
+            if now - self._cold_since < self.cfg.scale_down_idle_s:
+                return
+            self._cold_since = None
+            self._scale_down(n, p)
+        else:
+            self._cold_since = None
+
+    def _scale_up(self, now: float, n: int, p: float, reason: str) -> None:
+        if self.banned:
+            return
+        try:
+            name = self.pool.spawn_remote_replica()
+        except Exception as e:  # noqa: BLE001 — spawn failure is a strike
+            self._spawn_fails += 1
+            backoff = exponential_backoff(self.cfg.autoscale_backoff_s,
+                                          self.cfg.autoscale_backoff_max_s,
+                                          self._spawn_fails)
+            self._cooldown_until = now + backoff
+            logger.warning(f"autoscaler: spawn failed ({e!r}), strike "
+                           f"{self._spawn_fails}, backoff {backoff:.1f}s")
+            if self._spawn_fails >= self.cfg.autoscale_max_spawn_fails:
+                self.banned = True
+                logger.error("autoscaler: BANNED from scaling up after "
+                             f"{self._spawn_fails} consecutive spawn "
+                             "failures")
+                self._record("blocked", n=n, pressure=p, banned=True)
+            return
+        self._spawn_fails = 0
+        self._hot_since = None
+        self._cooldown_until = now + self.cfg.scale_up_debounce_s
+        self._record("up", n=n, pressure=p, replica=name, reason=reason)
+
+    def _scale_down(self, n: int, p: float) -> None:
+        # retire the newest (highest-index) routable replica so the
+        # stable core of the fleet keeps its warm engines
+        victims = [self.pool.replicas[i].name
+                   for i in self.pool.healthy_replicas()
+                   if self.pool.replicas[i].name not in self.pool._quiesced]
+        if len(victims) <= self.cfg.autoscale_min:
+            return
+        victim = victims[-1]
+        if self.pool.retire_replica(victim, self.cfg.drain_timeout_s):
+            self._record("down", n=n, pressure=p, replica=victim)
+
+    def _record(self, decision: str, **attrs) -> None:
+        self.decisions[decision] += 1
+        self.metrics.record_autoscale(decision)
+        logger.info(f"autoscaler: {decision} {attrs}")
+        tracer.add_event(f"autoscale/{decision}", attrs=attrs)
+        recorder.record_event(f"autoscale/{decision}", **attrs)

@@ -27,7 +27,6 @@ prefix and skipping the tokens the client already received.
 
 from __future__ import annotations
 
-import random
 import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
@@ -35,12 +34,14 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence
 from ..monitor.monitor import Monitor
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
+from ..utils.backoff import decorrelated_jitter
 from ..utils.logging import logger, request_logger
 from .broker import (BrokerStoppedError, QueueFullError, RequestBroker,
                      RequestFailedError)
 from .config import ServingConfig
 from .metrics import ServingMetrics
-from .transport import (InProcessReplica, ReplicaTransport, SubprocessReplica)
+from .transport import (FramedReplica, InProcessReplica, ReplicaTransport,
+                        SubprocessReplica)
 
 
 class NoReplicaError(RuntimeError):
@@ -88,9 +89,8 @@ class BalancedHandle:
         over at once — jitter de-synchronizes the stampede onto the
         survivors, and the cap bounds worst-case added latency."""
         cfg = self._pool.cfg
-        base = cfg.retry_backoff_s
-        return min(cfg.retry_backoff_max_s,
-                   random.uniform(base, max(base, 3.0 * prev)))
+        return decorrelated_jitter(cfg.retry_backoff_s,
+                                   cfg.retry_backoff_max_s, prev)
 
     def tokens(self, timeout: Optional[float] = None) -> Iterator[int]:
         attempts = 0
@@ -161,13 +161,23 @@ class ReplicaPool:
         # last-known per-replica health entries: the health endpoint must
         # answer (with a stale flag) even when a replica can't
         self._last_health: Dict[int, dict] = {}
+        # fleet plumbing (remote transport): set by build_remote
+        self.registry = None
+        self.autoscaler = None
+        self._launcher = None
+        #: replicas excluded from routing (rollout drains, scale-down) —
+        #: they stay healthy and finish their in-flight work
+        self._quiesced: set = set()
+        #: monotonically-increasing suffix for autoscaler-minted slot
+        #: names; never reused so traces/metrics stay unambiguous
+        self._slot_seq = len(self.replicas)
         self.supervisor = None
-        if any(isinstance(t, SubprocessReplica) for t in self.replicas):
+        if any(isinstance(t, FramedReplica) for t in self.replicas):
             from .supervisor import ReplicaSupervisor
 
             self.supervisor = ReplicaSupervisor(
                 [t for t in self.replicas
-                 if isinstance(t, SubprocessReplica)],
+                 if isinstance(t, FramedReplica)],
                 config, metrics=self.metrics)
 
     @classmethod
@@ -203,6 +213,34 @@ class ReplicaPool:
                       for i in range(config.num_replicas)]
         return cls(transports, config, metrics=metrics, monitor=monitor)
 
+    @classmethod
+    def build_remote(cls, worker_argv: Sequence[str],
+                     config: ServingConfig,
+                     metrics: Optional[ServingMetrics] = None,
+                     monitor: Optional[Monitor] = None,
+                     extra_env: Optional[Dict[str, str]] = None,
+                     launch_workers: bool = True) -> "ReplicaPool":
+        """Multi-host fleet: ``config.num_replicas`` registry slots that
+        workers claim by dialing in over TCP with fenced epochs
+        (``serving/remote.py``).  With ``launch_workers`` the pool also
+        spawns local worker processes pointed at its own registry (the
+        single-host deployment and the test harness); with it off the
+        slots wait for externally-launched workers and never respawn."""
+        from .remote import (LocalWorkerLauncher, RemoteReplica,
+                             WorkerRegistry)
+        metrics = metrics or ServingMetrics()
+        registry = WorkerRegistry(config, metrics)
+        launcher = (LocalWorkerLauncher(worker_argv, config, extra_env)
+                    if launch_workers else None)
+        slots = [RemoteReplica(config, f"replica{i}", metrics, launcher)
+                 for i in range(config.num_replicas)]
+        for s in slots:
+            registry.register_slot(s)
+        pool = cls(slots, config, metrics=metrics, monitor=monitor)
+        pool.registry = registry
+        pool._launcher = launcher
+        return pool
+
     # -- lifecycle -------------------------------------------------------
 
     def start(self, paused: bool = False) -> "ReplicaPool":
@@ -220,6 +258,8 @@ class ReplicaPool:
         return self
 
     def start_engines(self) -> None:
+        if self.registry is not None:  # listen before workers dial in
+            self.registry.start()
         for t in self.replicas:
             t.start()
         if self.supervisor is not None:
@@ -259,10 +299,116 @@ class ReplicaPool:
     def kill_replica(self, index: int, reason: str = "replica_dead") -> None:
         self.replicas[index].kill(reason)
 
+    # -- elastic membership (autoscaler, rolling swaps) ------------------
+
+    def quiesce(self, name: str) -> None:
+        """Exclude ``name`` from routing; in-flight work keeps running."""
+        with self._lock:
+            self._quiesced.add(name)
+
+    def resume_replica(self, name: str) -> None:
+        with self._lock:
+            self._quiesced.discard(name)
+
+    def _by_name(self, name: str) -> Optional[ReplicaTransport]:
+        for t in self.replicas:
+            if t.name == name:
+                return t
+        return None
+
+    def wait_drained(self, name: str, timeout: float) -> bool:
+        """Wait for a (quiesced) replica's in-flight work to finish.
+        True when it drained OR stopped being healthy (nothing left to
+        wait for — its streams already failed over); False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            t = self._by_name(name)
+            if t is None or not t.healthy():
+                return True
+            try:
+                if (t.num_running() == 0 and t.queue_depth() == 0
+                        and t.outstanding_tokens() == 0):
+                    return True
+            except Exception:  # noqa: BLE001 — dying mid-poll == drained
+                return True
+            time.sleep(0.05)
+        return False
+
+    def add_replica(self, transport: ReplicaTransport) -> None:
+        """Adopt and start a new replica slot mid-flight (scale-up)."""
+        with self._lock:
+            if any(t.name == transport.name for t in self.replicas):
+                raise ValueError(f"duplicate replica name {transport.name}")
+            # the pump/health threads iterate without the lock: publish a
+            # NEW list instead of mutating the one they may be walking
+            self.replicas = self.replicas + [transport]
+        if self.supervisor is not None and \
+                isinstance(transport, FramedReplica):
+            self.supervisor.add(transport)
+        transport.start()
+
+    def remove_replica(self, name: str) -> bool:
+        """Drop a slot from the pool and stop it.  Idempotent; returns
+        True only for the call that actually removed it — a simultaneous
+        scale-down and crash-cleanup can both call this, and exactly one
+        of them owns releasing the slot."""
+        with self._lock:
+            t = self._by_name(name)
+            if t is None:
+                return False
+            self.replicas = [x for x in self.replicas if x is not t]
+            self._quiesced.discard(name)
+            self._last_health = {}  # indices shifted; drop stale cache
+        if self.supervisor is not None and isinstance(t, FramedReplica):
+            self.supervisor.discard(t)
+        if self.registry is not None:
+            try:
+                self.registry.unregister_slot(name)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"serving: unregister {name} failed: {e!r}")
+        try:
+            t.stop(drain=False, timeout=5.0)
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"serving: stop of removed {name} failed: {e!r}")
+        return True
+
+    def retire_replica(self, name: str, drain_timeout_s: float) -> bool:
+        """Graceful scale-down: stop routing to ``name``, let its work
+        finish, then remove it.  The supervisor is detached FIRST so a
+        crash mid-drain can't race a respawn against the removal."""
+        t = self._by_name(name)
+        if t is None:
+            return False
+        self.quiesce(name)
+        if self.supervisor is not None and isinstance(t, FramedReplica):
+            self.supervisor.discard(t)
+        self.wait_drained(name, drain_timeout_s)
+        return self.remove_replica(name)
+
+    def spawn_remote_replica(self, name: Optional[str] = None) -> str:
+        """Mint, register, and start a fresh remote slot (scale-up)."""
+        if self.registry is None:
+            raise RuntimeError("spawn_remote_replica needs a remote pool")
+        from .remote import RemoteReplica
+        with self._lock:
+            if name is None:
+                name = f"replica{self._slot_seq}"
+            self._slot_seq += 1
+        slot = RemoteReplica(self.cfg, name, self.metrics, self._launcher)
+        self.registry.register_slot(slot)
+        try:
+            self.add_replica(slot)
+        except Exception:
+            self.registry.unregister_slot(name)
+            raise
+        return name
+
     def drain(self, timeout: Optional[float] = None) -> None:
         """Graceful shutdown: stop accepting, let outstanding requests
         finish inside the grace window, then stop the replicas."""
         self._accepting = False
+        if self.autoscaler is not None:  # no scaling during teardown
+            self.autoscaler.stop()
         if self.supervisor is not None:  # no respawns during teardown
             self.supervisor.stop()
         timeout = self.cfg.drain_timeout_s if timeout is None else timeout
@@ -274,11 +420,15 @@ class ReplicaPool:
             except Exception as e:  # noqa: BLE001 — a dead replica must
                 # not block draining the healthy ones
                 logger.warning(f"serving drain: {t.name} stop failed: {e!r}")
+        if self.registry is not None:
+            self.registry.stop()
         self._stop_pump()
 
     def shutdown(self) -> None:
         """Immediate shutdown: outstanding requests fail with ``shutdown``."""
         self._accepting = False
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.supervisor is not None:
             self.supervisor.stop()
         for t in self.replicas:
@@ -287,6 +437,8 @@ class ReplicaPool:
             except Exception as e:  # noqa: BLE001
                 logger.warning(f"serving shutdown: {t.name} stop failed: "
                                f"{e!r}")
+        if self.registry is not None:
+            self.registry.stop()
         self._stop_pump()
 
     def _stop_pump(self) -> None:
@@ -303,7 +455,9 @@ class ReplicaPool:
     # -- routing ---------------------------------------------------------
 
     def _pick(self, exclude: Sequence[int] = ()) -> int:
-        healthy = [i for i in self.healthy_replicas() if i not in exclude]
+        healthy = [i for i in self.healthy_replicas()
+                   if i not in exclude
+                   and self.replicas[i].name not in self._quiesced]
         if not healthy:
             raise NoReplicaError("no healthy replica")
         with self._lock:
@@ -449,6 +603,8 @@ class ReplicaPool:
              "kv_utilization": t.kv_utilization(),
              "stale": not t.healthy()}
             for t in self.replicas])
+        if self.registry is not None:
+            self.metrics.set_registry_members(self.registry.membership())
 
     def _pump_loop(self) -> None:
         while not self._pump_stop.wait(self.cfg.metrics_interval_s):

@@ -423,11 +423,19 @@ def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
                slo_path: Optional[str] = None,
                slo_workload: Optional[str] = None,
                model: str = "tiny", max_queue: int = 64,
-               save_trace: Optional[str] = None) -> dict:
+               save_trace: Optional[str] = None,
+               autoscale_min: int = 0, autoscale_max: int = 0) -> dict:
     """Replay a workload trace (recorded JSONL or seeded synthesis) against
     a fresh replica pool — driven at the pool, not over HTTP, so the same
     seed reproduces arrival schedule AND token streams exactly — then gate
     the TTFT/TPOT/goodput/queue-depth summary against ``slo.toml``.
+
+    ``transport="remote"`` runs the loopback-TCP fleet (dial-in workers
+    against the registry); with ``autoscale_max > 0`` it also runs the
+    goodput autoscaler between ``autoscale_min`` and ``autoscale_max``
+    replicas and reports its decisions in the result's ``autoscale`` key
+    (the load phase should show >=1 scale-up, the post-drain idle >=1
+    scale-down).
 
     The result carries ``slo_violations`` (named-key diffs); ``main``
     turns a non-empty list into a nonzero exit."""
@@ -466,26 +474,44 @@ def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
         "--max_tokens_per_step", "32", "--max_seqs", "4",
         "--block_size", "8", "--max_blocks_per_seq", "8",
         "--max_queue", str(max_queue)])
-    cfg = ServingConfig(max_queue=max_queue, num_replicas=replicas,
+    autoscaling = transport == "remote" and autoscale_max > 0
+    start_replicas = max(1, autoscale_min) if autoscaling else replicas
+    cfg = ServingConfig(max_queue=max_queue, num_replicas=start_replicas,
                         replica_transport=transport,
                         heartbeat_interval_s=0.2, heartbeat_timeout_s=2.0,
                         respawn_backoff_s=0.2, submit_timeout_s=120.0,
-                        spawn_timeout_s=300.0)
-    if transport == "subprocess":
+                        spawn_timeout_s=300.0,
+                        autoscale_min=max(1, autoscale_min),
+                        autoscale_max=autoscale_max,
+                        # replay load phases last seconds, so the scaling
+                        # thresholds must react inside one phase: low
+                        # pressure bar, sub-second debounce, short idle
+                        autoscale_interval_s=0.25,
+                        scale_up_pressure=6.0, scale_up_debounce_s=0.5,
+                        scale_down_pressure=1.0, scale_down_idle_s=2.0)
+    if transport in ("subprocess", "remote"):
         worker_argv = (engine_argv_from_args(eargs)
                        + serving_argv_from_config(cfg))
-        pool = ReplicaPool.build_subprocess(worker_argv, cfg)
+        if transport == "remote":
+            pool = ReplicaPool.build_remote(worker_argv, cfg)
+        else:
+            pool = ReplicaPool.build_subprocess(worker_argv, cfg)
     else:
         pool = ReplicaPool.build(build_engine_factory(eargs), cfg)
     pool.start()
     pool.wait_ready()
+    autoscaler = None
+    if autoscaling:
+        from .autoscaler import Autoscaler
+        autoscaler = Autoscaler(pool, cfg).start()
     leaked_blocks = leaked_procs = 0
+    autoscale_report = None
     try:
         # warm the compile caches (one concurrent request per replica:
         # least-outstanding routing spreads them) so the replay's TTFT
         # percentiles measure serving, not first-touch XLA compiles
         warm = [pool.submit([1, 2, 3], max_new_tokens=2)
-                for _ in range(replicas)]
+                for _ in range(len(pool.replicas))]
         for h in warm:
             h.result(timeout=300)
         out = rp.replay_workload(pool, wl, time_scale=time_scale,
@@ -501,9 +527,23 @@ def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
         leaked_blocks = int(sum(
             t.prefix_stats().get("pinned_blocks", 0)
             for t in pool.replicas if t.healthy()))
+        if autoscaler is not None:
+            # the fleet is idle now; give the autoscaler its idle window
+            # so the post-drain scale-down shows up in the report
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if autoscaler.decisions["down"] >= 1:
+                    break
+                time.sleep(0.25)
+            autoscale_report = {
+                "min": cfg.autoscale_min, "max": cfg.autoscale_max,
+                "decisions": dict(autoscaler.decisions),
+                "final_replicas": sum(
+                    1 for t in pool.replicas if t.healthy()),
+            }
     finally:
         pool.drain()
-    if transport == "subprocess":
+    if transport in ("subprocess", "remote"):
         leaked_procs = sum(
             1 for t in pool.replicas
             if getattr(t, "_proc", None) is not None
@@ -519,6 +559,7 @@ def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
         "chaos": chaos or None,
         "slo_workload": slo_workload,
         "summary": summary,
+        "autoscale": autoscale_report,
         "leaked_blocks_after_idle": leaked_blocks,
         "leaked_worker_processes_after_drain": leaked_procs,
         "slo_violations": [v.to_dict() for v in violations],
@@ -660,8 +701,15 @@ def main(argv=None) -> int:
                    help="replay: synthesized request count")
     p.add_argument("--cancel_fraction", type=float, default=0.0,
                    help="replay: synthesized cancel fraction")
-    p.add_argument("--transport", choices=["inprocess", "subprocess"],
-                   default="inprocess", help="replay: replica transport")
+    p.add_argument("--transport",
+                   choices=["inprocess", "subprocess", "remote"],
+                   default="inprocess", help="replay: replica transport "
+                   "(remote = loopback-TCP dial-in fleet)")
+    p.add_argument("--autoscale_min", type=int, default=0,
+                   help="replay --transport remote: autoscaler floor")
+    p.add_argument("--autoscale_max", type=int, default=0,
+                   help="replay --transport remote: autoscaler ceiling "
+                        "(0 disables the autoscaler)")
     p.add_argument("--time_scale", type=float, default=1.0,
                    help="replay: arrival-schedule scale (0.5 = 2x faster)")
     p.add_argument("--chaos", default=None,
@@ -685,7 +733,9 @@ def main(argv=None) -> int:
             replicas=args.replicas or 2, time_scale=args.time_scale,
             chaos=args.chaos, slo_path=args.slo,
             slo_workload=args.slo_workload,
-            max_queue=args.max_queue or 64, save_trace=args.save_trace)
+            max_queue=args.max_queue or 64, save_trace=args.save_trace,
+            autoscale_min=args.autoscale_min,
+            autoscale_max=args.autoscale_max)
         key = "replay"
     elif args.mode == "gemm":
         result = run_gemm_sweep(

@@ -322,6 +322,41 @@ class RequestBroker:
             with self._wake:
                 self._fail_all_locked(reason)
 
+    def swap_params(self, raw_params, wait_idle_s: float = 5.0) -> None:
+        """Rolling weight swap: point the engine at new params between
+        steps.  The caller (``serving/rollout.py`` or a worker ``swap``
+        op) quiesces and drains this replica first; we still wait
+        briefly for the engine loop to go idle — drain checks read
+        cross-thread stats that can lag by one iteration — then swap
+        under the broker lock so no admit races the pointer move."""
+        deadline = time.monotonic() + wait_idle_s
+        while True:
+            with self._wake:
+                if self._dead or self._stop:
+                    raise BrokerStoppedError(
+                        f"broker {self.name} not serving")
+                if not (self.engine.running or self.engine.waiting
+                        or self._queue):
+                    self.engine.swap_params(raw_params)
+                    break
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"swap_params: {self.name} still busy after "
+                    f"{wait_idle_s:.1f}s — drain before swapping")
+            time.sleep(0.01)
+        tracer.add_event("broker/swap", attrs={"replica": self.name})
+        recorder.record_event("broker/swap", replica=self.name)
+
+    def swap_rollback(self) -> None:
+        """Restore the pre-swap weights (failed post-swap probe)."""
+        with self._wake:
+            if self._dead:
+                raise BrokerStoppedError(f"broker {self.name} dead")
+            self.engine.swap_rollback()
+        tracer.add_event("broker/swap_rollback",
+                         attrs={"replica": self.name})
+        recorder.record_event("broker/swap_rollback", replica=self.name)
+
     def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
         with self._wake:
             self._stop = True

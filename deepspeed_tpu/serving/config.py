@@ -86,3 +86,46 @@ class ServingConfig:
     circuit_breaker_threshold: int = 3
     #: a worker that stays healthy this long resets its crash streak
     respawn_reset_s: float = 5.0
+
+    # -- multi-host fleet (remote transport — serving/remote.py) ---------
+    #: shared-secret auth token for worker registration hellos; None
+    #: disables auth (loopback/dev).  Workers read it from
+    #: ``$DSTPU_FLEET_TOKEN``, never argv.
+    fleet_token: Optional[str] = None
+    #: registry bind address; port 0 picks an ephemeral port (tests)
+    registry_host: str = "127.0.0.1"
+    registry_port: int = 0
+    #: hello send → reply budget per registration attempt (the only true
+    #: socket timeout; steady-state deadlines are application-layer)
+    hello_timeout_s: float = 5.0
+    #: how long a remote slot whose CONNECTION dropped keeps its place
+    #: past its last heartbeat before the supervisor escalates to the
+    #: dead-worker path — the knob that tells network loss from death
+    lease_ttl_s: float = 10.0
+
+    # -- autoscaler (serving/autoscaler.py) ------------------------------
+    #: replica count floor the autoscaler restores immediately
+    autoscale_min: int = 1
+    #: ceiling; 0 disables autoscaling entirely
+    autoscale_max: int = 0
+    #: control-loop period
+    autoscale_interval_s: float = 0.5
+    #: pressure = (queued requests + outstanding tokens) / healthy
+    #: replicas; above this, sustained scale_up_debounce_s → scale up
+    scale_up_pressure: float = 32.0
+    scale_up_debounce_s: float = 1.0
+    #: below this, sustained scale_down_idle_s → drain + retire one
+    scale_down_pressure: float = 2.0
+    scale_down_idle_s: float = 3.0
+    #: consecutive spawn failures before the autoscaler bans itself from
+    #: growing (elastic-agent ban discipline for flapping hosts)
+    autoscale_max_spawn_fails: int = 3
+    autoscale_backoff_s: float = 1.0
+    autoscale_backoff_max_s: float = 30.0
+
+    # -- rolling weight swaps (serving/rollout.py) -----------------------
+    #: per-replica drain budget before its swap
+    rollout_drain_timeout_s: float = 30.0
+    #: post-swap health-probe decode budget (greedy, token-checked)
+    rollout_probe_tokens: int = 4
+    rollout_probe_timeout_s: float = 120.0

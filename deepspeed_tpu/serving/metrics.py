@@ -122,12 +122,18 @@ class ServingMetrics:
         self.kv_utilization = 0.0
         # per-replica labeled series for /metrics (set by the pool pump)
         self.replica_stats: List[Dict[str, float]] = []
-        # fleet lifecycle counters (subprocess transport + supervisor):
+        # fleet lifecycle counters (transports + supervisor + registry):
         # spawns/respawns/deaths/detections — the robustness ledger
         self.fleet: Dict[str, int] = {
             "spawns": 0, "respawns": 0, "worker_deaths": 0,
             "heartbeat_misses": 0, "hung_detected": 0, "circuit_opens": 0,
+            "registrations": 0, "fenced": 0, "stale_epoch_rejects": 0,
+            "lease_expiries": 0, "protocol_errors": 0,
         }
+        # autoscaler decision counters (serving/autoscaler.py)
+        self.autoscale: Dict[str, int] = {"up": 0, "down": 0, "blocked": 0}
+        # registry membership (remote transport; set by the pool pump)
+        self.registry_members: List[Dict[str, float]] = []
         # prefix-cache mirror (engine-owned counters, summed over replicas
         # by the pump; all zero when the cache is disabled)
         self.prefix: Dict[str, float] = {
@@ -179,11 +185,26 @@ class ServingMetrics:
             self.failovers += 1
 
     def record_fleet(self, key: str, n: int = 1) -> None:
-        """Replica lifecycle counter (transport + supervisor): one of
-        ``spawns``, ``respawns``, ``worker_deaths``, ``heartbeat_misses``,
-        ``hung_detected``, ``circuit_opens``."""
+        """Replica lifecycle counter (transport + supervisor + registry):
+        e.g. ``spawns``, ``respawns``, ``worker_deaths``,
+        ``heartbeat_misses``, ``hung_detected``, ``circuit_opens``,
+        ``registrations``, ``fenced``, ``stale_epoch_rejects``,
+        ``lease_expiries``."""
         with self._lock:
             self.fleet[key] = self.fleet.get(key, 0) + n
+
+    def record_autoscale(self, key: str, n: int = 1) -> None:
+        """Autoscaler decision counter: ``up``, ``down``, or ``blocked``
+        (wanted to grow but the max bound / ban said no)."""
+        with self._lock:
+            self.autoscale[key] = self.autoscale.get(key, 0) + n
+
+    def set_registry_members(self, members: Sequence[Dict]) -> None:
+        """Registry membership for /metrics: one entry per fleet slot with
+        ``worker``, ``epoch``, ``connected`` (see
+        ``WorkerRegistry.membership``)."""
+        with self._lock:
+            self.registry_members = [dict(m) for m in members]
 
     def record_finish(self, reason: str, within_deadline: bool = True) -> None:
         """Terminal disposition.  ``within_deadline`` is the broker's
@@ -267,6 +288,8 @@ class ServingMetrics:
                 out[f"spec_{k}"] = float(v)
             for k, v in self.fleet.items():
                 out[f"replica_{k}"] = float(v)
+            for k, v in self.autoscale.items():
+                out[f"autoscale_{k}"] = float(v)
             return out
 
     def to_events(self, step: int) -> List[Event]:
@@ -299,6 +322,7 @@ class ServingMetrics:
         snap = self.snapshot()
         with self._lock:
             replica_stats = [dict(s) for s in self.replica_stats]
+            registry_members = [dict(m) for m in self.registry_members]
         b = ExpositionBuilder()
         pre = "dstpu_serving_"
         for k, help_text in self._COUNTER_HELP.items():
@@ -339,11 +363,42 @@ class ServingMetrics:
                              "stale progress).",
             "circuit_opens": "Replica slots retired by the crash-loop "
                              "circuit breaker.",
+            "registrations": "Worker registrations accepted by the "
+                             "fleet registry.",
+            "fenced": "Live connections severed by a newer-epoch "
+                      "registration.",
+            "stale_epoch_rejects": "Registrations rejected for a stale "
+                                   "or duplicate fencing epoch.",
+            "lease_expiries": "Remote slots whose lease expired after a "
+                              "connection loss (escalated to death).",
+            "protocol_errors": "Connections dropped for unparseable "
+                               "frames (bad magic, oversize, garbage).",
         }
         for k in self.fleet:
             b.counter(f"{pre}replica_{k}",
                       _FLEET_HELP.get(k, f"Fleet: {k.replace('_', ' ')}."),
                       snap[f"replica_{k}"])
+        _AUTOSCALE_HELP = {
+            "up": "Autoscaler scale-up decisions (replica spawned).",
+            "down": "Autoscaler scale-down decisions (replica drained "
+                    "and retired).",
+            "blocked": "Scale-ups wanted but blocked by the max bound "
+                       "or the spawn-failure ban.",
+        }
+        for k in self.autoscale:
+            b.counter(f"{pre}autoscale_{k}",
+                      _AUTOSCALE_HELP.get(k,
+                                          f"Autoscale: {k}."),
+                      snap[f"autoscale_{k}"])
+        if registry_members:
+            b.gauge_series(
+                f"{pre}registry_member",
+                "Fleet registry membership: 1 connected / 0 not, "
+                "labeled by worker and fencing epoch.",
+                [({"worker": str(m.get("worker", i)),
+                   "epoch": str(m.get("epoch", 0))},
+                  1.0 if m.get("connected") else 0.0)
+                 for i, m in enumerate(registry_members)])
         if replica_stats:
             # "stale" is a label, not a gauge: a dead replica's series keep
             # their last-known values but carry stale="true" so dashboards

@@ -34,6 +34,7 @@ max_queue`); deadline-shed requests return 504.
 from __future__ import annotations
 
 import json
+import os
 import signal
 import subprocess
 import sys
@@ -442,7 +443,15 @@ def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
                         stop_token_ids=stop_ids,
                         idle_wait_s=args.idle_wait_s,
                         num_replicas=args.replicas,
-                        replica_transport=args.replica_transport)
+                        replica_transport=args.replica_transport,
+                        # token comes from the environment, never argv
+                        # (argv is world-readable in ps)
+                        fleet_token=os.environ.get("DSTPU_FLEET_TOKEN"),
+                        registry_host=getattr(args, "registry_host",
+                                              "127.0.0.1"),
+                        registry_port=getattr(args, "registry_port", 0),
+                        autoscale_min=getattr(args, "autoscale_min", 1),
+                        autoscale_max=getattr(args, "autoscale_max", 0))
     monitor = None
     if args.csv_dir:
         from ..monitor.monitor import CSVMonitor
@@ -454,6 +463,12 @@ def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
                        + serving_argv_from_config(cfg))
         pool = ReplicaPool.build_subprocess(worker_argv, cfg,
                                             metrics=metrics, monitor=monitor)
+    elif args.replica_transport == "remote":
+        worker_argv = (engine_argv_from_args(args)
+                       + serving_argv_from_config(cfg))
+        pool = ReplicaPool.build_remote(
+            worker_argv, cfg, metrics=metrics, monitor=monitor,
+            launch_workers=not getattr(args, "external_workers", False))
     else:
         pool = ReplicaPool.build(build_engine_factory(args), cfg,
                                  metrics=metrics, monitor=monitor)
@@ -529,12 +544,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--replicas", type=int, default=1)
-    p.add_argument("--replica_transport", choices=["inprocess", "subprocess"],
+    p.add_argument("--replica_transport",
+                   choices=["inprocess", "subprocess", "remote"],
                    default="inprocess",
                    help="'subprocess' isolates each replica in its own "
                         "process (own XLA runtime) behind the supervised "
                         "transport — a replica crash/hang costs one worker, "
-                        "never the front")
+                        "never the front; 'remote' runs a TCP registry that "
+                        "workers dial into with fenced epochs (multi-host "
+                        "fleet; local workers are spawned unless "
+                        "--external_workers)")
+    p.add_argument("--registry_host", default="127.0.0.1",
+                   help="remote transport: registry bind address (bind a "
+                        "routable interface for multi-host fleets)")
+    p.add_argument("--registry_port", type=int, default=0,
+                   help="remote transport: registry port (0 = ephemeral)")
+    p.add_argument("--external_workers", action="store_true",
+                   help="remote transport: do not spawn local workers — "
+                        "slots wait for workers launched elsewhere to dial "
+                        "in (auth via $DSTPU_FLEET_TOKEN)")
+    p.add_argument("--autoscale_min", type=int, default=1,
+                   help="remote transport: replica-count floor the "
+                        "autoscaler restores immediately")
+    p.add_argument("--autoscale_max", type=int, default=0,
+                   help="remote transport: autoscaler ceiling "
+                        "(0 disables autoscaling)")
     add_engine_cli_args(p)
     add_serving_cli_args(p)
     p.add_argument("--csv_dir", default=None,
@@ -544,6 +578,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     pool, metrics, cfg = _build_pool_from_args(args)
     pool.start()
     pool.wait_ready(timeout=cfg.spawn_timeout_s)
+    if args.replica_transport == "remote" and cfg.autoscale_max:
+        from .autoscaler import Autoscaler
+
+        Autoscaler(pool, cfg, metrics).start()
     server = create_server(pool, metrics, cfg, host=args.host, port=args.port,
                            model_name=args.model)
     stop = threading.Event()

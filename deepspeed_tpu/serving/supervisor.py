@@ -1,7 +1,7 @@
 """Replica supervisor: detect dead / hung workers, respawn with backoff.
 
 The control loop over :class:`~deepspeed_tpu.serving.transport.
-SubprocessReplica` slots, structurally the serving-side sibling of the
+FramedReplica` slots, structurally the serving-side sibling of the
 elastic agent's generation loop (``elasticity/elastic_agent.py``): watch,
 declare failure, restart, and stop restarting when restarts stop helping.
 
@@ -21,6 +21,15 @@ Detection hierarchy, cheapest signal first (each tick, per replica):
 5. **dead broker** — the worker reports its own engine thread died
    (``broker_healthy`` false in the heartbeat): the process is fine but
    the replica can't serve; recycle it.
+
+Network loss vs worker death (remote transport): a remote slot that
+goes down for a *network* reason (``connection_lost``, heartbeat
+timeout) keeps a **lease** for ``lease_ttl_s`` past its last heartbeat
+— its streams already failed over, but the slot waits for the worker to
+dial back in before anything is respawned.  Only lease expiry escalates
+to the dead-worker path (counted once, ``lease_expiries``); and a slot
+whose worker is launched externally (``can_respawn`` False) never
+respawns at all — it just waits for re-registration.
 
 Declaring down fails the in-flight streams with ``replica_dead`` → the
 balancer resubmits on a surviving replica, skipping the delivered prefix
@@ -48,23 +57,31 @@ from typing import List, Optional, Sequence
 
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
+from ..utils.backoff import exponential_backoff
 from ..utils.logging import logger
 from .config import ServingConfig
 from .metrics import ServingMetrics
-from .transport import SubprocessReplica
+from .transport import FramedReplica
+
+#: down-reasons that may mean the NETWORK died, not the worker — a remote
+#: slot holds its lease open on these and waits for re-registration
+_NETWORK_LOSS = ("connection_lost", "heartbeat_timeout")
 
 
 class ReplicaSupervisor:
-    """Health-check + respawn loop over subprocess replica slots."""
+    """Health-check + respawn loop over framed replica slots (subprocess
+    and remote).  Membership is dynamic: the autoscaler adds and removes
+    slots while the loop runs."""
 
-    def __init__(self, replicas: Sequence[SubprocessReplica],
+    def __init__(self, replicas: Sequence[FramedReplica],
                  config: ServingConfig,
                  metrics: Optional[ServingMetrics] = None):
-        self.replicas: List[SubprocessReplica] = list(replicas)
+        self.replicas: List[FramedReplica] = list(replicas)
         self.cfg = config
         self.metrics = metrics
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._members_lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -82,9 +99,23 @@ class ReplicaSupervisor:
             self._thread.join(timeout=5.0)
             self._thread = None
 
+    def add(self, r: FramedReplica) -> None:
+        """Adopt a slot mid-flight (autoscaler scale-up)."""
+        with self._members_lock:
+            if r not in self.replicas:
+                self.replicas = self.replicas + [r]
+
+    def discard(self, r: FramedReplica) -> None:
+        """Stop watching a slot (scale-down retire) — call BEFORE the
+        drain so a crash mid-drain can't race a respawn."""
+        with self._members_lock:
+            self.replicas = [x for x in self.replicas if x is not r]
+
     def _run(self) -> None:
         while not self._stop.wait(self.cfg.supervise_interval_s):
-            for r in self.replicas:
+            with self._members_lock:
+                snapshot = list(self.replicas)
+            for r in snapshot:
                 try:
                     self._tick(r)
                 except Exception as e:  # noqa: BLE001 — one bad slot must
@@ -94,16 +125,35 @@ class ReplicaSupervisor:
 
     # -- per-replica state machine ---------------------------------------
 
-    def _tick(self, r: SubprocessReplica) -> None:
+    def _tick(self, r: FramedReplica) -> None:
         live = r.liveness()
         if live["stopping"]:
             return
         if live["down"] is None:
             self._check_health(r, live)
-        else:
-            self._maybe_respawn(r)
+            return
+        # down: before respawning, give a network-lossy remote slot its
+        # lease — the worker may dial back in with its engine still hot
+        lease = live.get("lease_remaining")
+        if live["down"] in _NETWORK_LOSS and lease is not None:
+            if lease > 0:
+                return  # streams failed over already; wait out the lease
+            if not r.lease_escalated:
+                r.lease_escalated = True
+                logger.warning(f"supervisor: {r.name} lease expired "
+                               f"({live['down']}) — escalating to death")
+                if self.metrics is not None:
+                    self.metrics.record_fleet("lease_expiries")
+                tracer.add_event("replica/lease_expired",
+                                 attrs={"replica": r.name,
+                                        "reason": live["down"]})
+                recorder.record_event("replica/lease_expired",
+                                      replica=r.name, reason=live["down"])
+        if not getattr(r, "can_respawn", True):
+            return  # externally-managed: only re-registration revives it
+        self._maybe_respawn(r)
 
-    def _check_health(self, r: SubprocessReplica, live: dict) -> None:
+    def _check_health(self, r: FramedReplica, live: dict) -> None:
         if not live["connected"]:
             return  # still spawning; the connector enforces spawn_timeout_s
         if not live["alive"]:
@@ -124,7 +174,7 @@ class ReplicaSupervisor:
                         f"({r.consecutive_failures}) cleared")
             r.consecutive_failures = 0
 
-    def _declare(self, r: SubprocessReplica, reason: str, counter: str,
+    def _declare(self, r: FramedReplica, reason: str, counter: str,
                  **attrs) -> None:
         logger.warning(f"supervisor: declaring {r.name} gen {r.generation} "
                        f"down: {reason} {attrs or ''}")
@@ -137,7 +187,7 @@ class ReplicaSupervisor:
                               generation=r.generation, **attrs)
         r.mark_down(reason)
 
-    def _maybe_respawn(self, r: SubprocessReplica) -> None:
+    def _maybe_respawn(self, r: FramedReplica) -> None:
         if r.circuit_open:
             return
         now = time.monotonic()
@@ -160,10 +210,9 @@ class ReplicaSupervisor:
                                       replica=r.name,
                                       failures=r.consecutive_failures)
                 return
-            backoff = min(
-                self.cfg.respawn_backoff_max_s,
-                self.cfg.respawn_backoff_s
-                * (2 ** (r.consecutive_failures - 1)))
+            backoff = exponential_backoff(self.cfg.respawn_backoff_s,
+                                          self.cfg.respawn_backoff_max_s,
+                                          r.consecutive_failures)
             r.next_respawn_at = now + backoff
             logger.info(f"supervisor: respawning {r.name} in {backoff:.2f}s "
                         f"(failure #{r.consecutive_failures})")

@@ -5,7 +5,7 @@ Capability analogue of DeepSpeed-MII's replica fan-out
 **processes** over gRPC.  This module puts the same seam into our pool:
 :class:`ReplicaPool` routes over :class:`ReplicaTransport` objects and
 never touches an engine directly, so the same least-outstanding-tokens
-routing and delivered-prefix failover drive both implementations:
+routing and delivered-prefix failover drive every implementation:
 
 * :class:`InProcessReplica` — the original arrangement: a
   :class:`~deepspeed_tpu.serving.broker.RequestBroker` engine thread in
@@ -18,15 +18,35 @@ routing and delivered-prefix failover drive both implementations:
   local TCP socket with a length-prefixed JSON protocol.  A replica
   segfault, OOM, or hang is contained to that process; the supervisor
   (``serving/supervisor.py``) detects it by heartbeat and respawns it.
+* :class:`~deepspeed_tpu.serving.remote.RemoteReplica` — the same frame
+  protocol over a real network: the worker **dials in** to the pool's
+  registry with a versioned, authenticated hello carrying a fencing
+  epoch (``serving/remote.py``).
+
+The protocol-speaking core (reader thread, frame demux, stream failover,
+swap control ops, liveness) lives in :class:`FramedReplica`; subprocess
+and remote transports differ only in how the peer comes to exist and how
+it is torn down — the ``_peer_*`` hook methods.
 
 Wire protocol (4-byte big-endian length + UTF-8 JSON, both directions):
 
 * pool → worker: ``{"op": "submit", "rid", "prompt", ...}``,
   ``{"op": "cancel", "rid"}``, ``{"op": "fault", "spec"}`` (chaos hook:
-  arm ``utils/faults`` sites inside the worker), ``{"op": "stop"}``.
+  arm ``utils/faults`` sites inside the worker), ``{"op": "swap",
+  "ckpt_dir", "cid"}`` / ``{"op": "swap_rollback", "cid"}`` (rolling
+  weight swaps — ``serving/rollout.py``), ``{"op": "stop"}``.
 * worker → pool: ``{"ev": "hb", "stats"}`` heartbeats (liveness + the
   stats the pool's routing and gauges need), ``accepted``/``rejected``
-  submit acks, ``tok``/``done``/``err`` per-request stream frames.
+  submit acks, ``tok``/``done``/``err`` per-request stream frames,
+  ``swap_ok``/``swap_err`` control acks keyed by ``cid``.
+
+Frame hardening: a corrupt or hostile peer must cost one connection,
+never a traceback in the reader thread.  An oversized length prefix or
+an undecodable payload raises :class:`ProtocolError` (a
+``ConnectionError`` subclass, so every existing except-clause already
+closes the connection cleanly); a mid-frame truncation raises plain
+``ConnectionError``.  Garbage bytes (say an HTTP request hitting the
+registry port) decode as an absurd length prefix and die the same way.
 
 A dead worker fails its in-flight streams with ``replica_dead``; the
 balancer resubmits on a surviving replica and skips the tokens the client
@@ -61,9 +81,22 @@ from .metrics import ServingMetrics
 
 READY_MARKER = "dstpu-worker listening on "
 
+#: hello-frame magic + protocol version (serving/remote.py handshake);
+#: a version bump is a fleet-wide flag day — the registry rejects
+#: mismatches rather than guessing at frame semantics
+FLEET_MAGIC = "dstpu-fleet"
+PROTO_VERSION = 1
+
 _LEN = struct.Struct(">I")
 #: sanity cap on a single frame (a corrupt length prefix must not OOM us)
 MAX_FRAME = 32 * 1024 * 1024
+
+
+class ProtocolError(ConnectionError):
+    """The peer sent bytes that cannot be a frame (oversized length
+    prefix, undecodable payload, bad hello).  Subclasses
+    ``ConnectionError`` so every reader already tears the connection
+    down cleanly instead of leaking a raw struct/JSON traceback."""
 
 
 def send_frame(sock: socket.socket, obj: Dict[str, Any],
@@ -78,7 +111,10 @@ def send_frame(sock: socket.socket, obj: Dict[str, Any],
 
 
 def recv_frame(rfile) -> Optional[Dict[str, Any]]:
-    """Read one frame from a buffered socket file; None on clean EOF."""
+    """Read one frame from a buffered socket file; None on clean EOF.
+    Raises :class:`ProtocolError` for frames that can never be valid
+    (oversize, garbage payload) and plain ``ConnectionError`` for
+    mid-frame truncation (the peer died mid-send)."""
     header = rfile.read(_LEN.size)
     if not header:
         return None
@@ -86,11 +122,14 @@ def recv_frame(rfile) -> Optional[Dict[str, Any]]:
         raise ConnectionError("truncated frame header")
     (n,) = _LEN.unpack(header)
     if n > MAX_FRAME:
-        raise ConnectionError(f"frame of {n} bytes exceeds cap {MAX_FRAME}")
+        raise ProtocolError(f"frame of {n} bytes exceeds cap {MAX_FRAME}")
     payload = rfile.read(n)
     if len(payload) < n:
         raise ConnectionError("truncated frame payload")
-    return json.loads(payload)
+    try:
+        return json.loads(payload)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise ProtocolError(f"undecodable frame payload: {e}") from e
 
 
 class ReplicaTransport(abc.ABC):
@@ -179,6 +218,17 @@ class InProcessReplica(ReplicaTransport):
     def cancel(self, rid: str) -> bool:
         return self.broker.cancel(rid)
 
+    def swap(self, ckpt_dir: str, timeout: Optional[float] = None) -> None:
+        """Rolling-rollout hook: load a committed checkpoint and pointer-
+        swap it into the engine (``serving/rollout.py`` quiesces first)."""
+        from .rollout import load_swap_params  # avoid an import cycle
+
+        self.broker.swap_params(
+            load_swap_params(ckpt_dir, self.broker.engine))
+
+    def swap_rollback(self, timeout: Optional[float] = None) -> None:
+        self.broker.swap_rollback()
+
     def queue_depth(self) -> int:
         return self.broker.queue_depth()
 
@@ -203,7 +253,7 @@ class RemoteHandle:
     surface as :class:`~deepspeed_tpu.serving.broker.RequestHandle`, fed
     by the transport's reader thread demultiplexing stream frames."""
 
-    def __init__(self, transport: "SubprocessReplica", rid: str,
+    def __init__(self, transport: "FramedReplica", rid: str,
                  prompt: List[int]):
         self._transport = transport
         self.rid = rid
@@ -230,40 +280,49 @@ class RemoteHandle:
         return list(self.tokens(timeout=timeout))
 
 
-class SubprocessReplica(ReplicaTransport):
-    """A replica living in its own process (its own XLA runtime), reached
-    over the length-prefixed socket protocol.  Restartable: after a death
-    the supervisor calls :meth:`respawn` and the same object serves the
-    next worker generation (the pool's routing indexes stay stable).
+class FramedReplica(ReplicaTransport):
+    """Everything a frame-protocol replica shares, however the socket
+    came to exist: the reader thread, stream/ack/control demux, the
+    idempotent death transition, submit/cancel/fault/swap ops, heartbeat-
+    carried stats, and the supervisor's liveness surface.
 
-    ``worker_argv`` is the ``python -m deepspeed_tpu.serving.worker``
-    argument list describing the engine (model, geometry, caching/spec
-    flags); ``extra_env`` is merged into the worker environment on every
-    (re)spawn — chaos tests use it to arm persistent ``DSTPU_FAULTS``."""
+    Subclasses supply peer management through small hooks:
 
-    transport = "subprocess"
+    * :meth:`_peer_alive` / :meth:`_peer_pid` — called UNDER ``_lock``,
+      must not block (a ``proc.poll()``, a flag read);
+    * :meth:`_disconnect_reason` — what a surprise EOF means
+      (``replica_dead`` for a local child, ``connection_lost`` for a
+      network peer — the supervisor treats them differently);
+    * :meth:`_teardown_peer` / :meth:`_force_kill_peer` /
+      :meth:`_await_peer_exit` — reaping;
+    * :meth:`_lease_remaining` — None when liveness is process-identity
+      (subprocess); a countdown for network peers whose connection loss
+      is survivable until the lease runs out (``serving/remote.py``).
+    """
 
-    def __init__(self, worker_argv: Sequence[str], config: ServingConfig,
-                 name: str = "replica0",
-                 metrics: Optional[ServingMetrics] = None,
-                 extra_env: Optional[Dict[str, str]] = None):
-        self.worker_argv = list(worker_argv)
+    transport = "framed"
+    #: False for registry slots whose workers are launched externally —
+    #: the supervisor then waits for re-registration instead of respawning
+    can_respawn = True
+
+    def __init__(self, config: ServingConfig, name: str,
+                 metrics: Optional[ServingMetrics] = None):
         self.cfg = config
         self.name = name
         self.metrics = metrics
-        self.extra_env = dict(extra_env or {})
         self._lock = threading.Lock()
         self._wlock = threading.Lock()
-        self._proc: Optional[subprocess.Popen] = None
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._pending: Dict[str, RemoteHandle] = {}
         self._acks: Dict[str, "queue.Queue"] = {}
+        self._ctrl: Dict[str, "queue.Queue"] = {}
         self._stats: Dict[str, Any] = {}
         self._connected = threading.Event()
         self._down: Optional[str] = None
         self._stopping = False
         self._last_hb = 0.0
+        self._hb_pid: Optional[int] = None
         self._rid_counter = itertools.count(1)
         # supervisor bookkeeping (serving/supervisor.py)
         self.generation = 0
@@ -271,122 +330,83 @@ class SubprocessReplica(ReplicaTransport):
         self.consecutive_failures = 0
         self.circuit_open = False
         self.next_respawn_at = 0.0
+        #: set once the supervisor has escalated an expired lease — so
+        #: lease expiry triggers failover exactly once per outage
+        self.lease_escalated = False
 
-    # -- lifecycle -------------------------------------------------------
+    # -- peer hooks (subclass responsibility) ----------------------------
 
-    def start(self) -> "SubprocessReplica":
-        """Spawn the worker and return immediately; a connector thread
-        waits for the ready line and wires the socket.  ``healthy()``
-        flips true once connected (use ``ReplicaPool.wait_ready``)."""
-        with self._lock:
-            if self._proc is not None and self._down is None:
-                return self
-            self._down = None
-            self._stopping = False
-            self._connected.clear()
-            self._pending = {}
-            self._acks = {}
-            self._stats = {}
-            self.spawn_ts = time.monotonic()
-        env = dict(os.environ)
-        # the worker must import deepspeed_tpu regardless of caller cwd
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        prev = env.get("PYTHONPATH")
-        env["PYTHONPATH"] = (pkg_root + os.pathsep + prev) if prev \
-            else pkg_root
-        env.update(self.extra_env)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "deepspeed_tpu.serving.worker",
-             "--name", f"{self.name}.g{self.generation}",
-             "--heartbeat_interval_s", str(self.cfg.heartbeat_interval_s),
-             *self.worker_argv],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env, start_new_session=True)
-        with self._lock:
-            self._proc = proc
-        logger.info(f"serving transport: spawned worker {self.name} "
-                    f"gen {self.generation} pid {proc.pid}")
-        tracer.add_event("replica/spawn",
-                         attrs={"replica": self.name, "pid": proc.pid,
-                                "generation": self.generation})
-        recorder.record_event("replica/spawn", replica=self.name,
-                              pid=proc.pid, generation=self.generation)
-        if self.metrics is not None:
-            self.metrics.record_fleet(
-                "respawns" if self.generation else "spawns")
-        threading.Thread(target=self._connector, args=(proc,),
-                         name=f"dstpu-connect-{self.name}",
-                         daemon=True).start()
-        return self
+    def _peer_alive(self) -> bool:
+        """Is the peer still with us?  Called under ``_lock``."""
+        return self._down is None and self._connected.is_set()
 
-    def respawn(self) -> "SubprocessReplica":
+    def _peer_pid(self) -> Optional[int]:
+        """Peer pid if known.  Called under ``_lock``."""
+        return self._hb_pid
+
+    def _disconnect_reason(self) -> str:
+        """Down-reason for a surprise EOF / read error."""
+        return "replica_dead"
+
+    def _teardown_peer(self, reason: str) -> None:
+        """Reap whatever backs the peer after a death declaration."""
+
+    def _force_kill_peer(self) -> None:
+        """SIGKILL-grade teardown for :meth:`kill` (chaos tests)."""
+
+    def _await_peer_exit(self, timeout: float) -> None:
+        """Wait for the peer to exit after a graceful stop frame."""
+
+    def _lease_remaining(self, now: float) -> Optional[float]:
+        """Seconds of lease left, or None when liveness needs no lease."""
+        return None
+
+    def respawn(self) -> "FramedReplica":
         """Next worker generation after a death (supervisor-driven)."""
         with self._lock:
             self.generation += 1
-            self._proc = None  # previous generation already reaped
         return self.start()
 
-    def _connector(self, proc: subprocess.Popen) -> None:
-        """Wait for the worker's ready line, connect, then keep draining
-        worker stdout (its logs) so the pipe can never fill and block it."""
-        deadline = self.spawn_ts + self.cfg.spawn_timeout_s
-        addr = None
-        try:
-            while time.monotonic() < deadline:
-                line = proc.stdout.readline()
-                if not line:
-                    rc = proc.poll()
-                    raise RuntimeError(f"worker exited rc={rc} before ready")
-                if READY_MARKER in line:
-                    addr = line.split(READY_MARKER, 1)[1].strip()
-                    break
-                logger.debug(f"worker[{self.name}]: {line.rstrip()}")
-            if addr is None:
-                raise TimeoutError(
-                    f"worker not ready in {self.cfg.spawn_timeout_s:.0f}s")
-            host, port = addr.rsplit(":", 1)
-            sock = socket.create_connection((host, int(port)), timeout=30.0)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._lock:
-                if self._down is not None or proc is not self._proc:
-                    sock.close()
-                    return
-                self._sock = sock
-                self._rfile = sock.makefile("rb")
-                self._last_hb = time.monotonic()
-            self._connected.set()
-            threading.Thread(target=self._reader, args=(proc,),
-                             name=f"dstpu-reader-{self.name}",
-                             daemon=True).start()
-        except Exception as e:
-            logger.error(f"serving transport: worker {self.name} spawn "
-                         f"failed: {e!r}")
-            self._declare_down(f"spawn_failed: {e}", from_spawn=True)
-            return
-        # stdout drain (post-ready): worker logs route to our logger
-        try:
-            for line in proc.stdout:
-                logger.debug(f"worker[{self.name}]: {line.rstrip()}")
-        except (OSError, ValueError):
-            pass
+    # -- stream wiring ---------------------------------------------------
 
-    def _reader(self, proc: subprocess.Popen) -> None:
-        rfile = self._rfile
+    def _wire(self, sock: socket.socket, rfile, guard=None) -> bool:
+        """Install a connected stream and start its reader thread.
+        ``guard()`` runs under the lock; returning False aborts (the slot
+        was torn down or moved on while we connected)."""
+        with self._lock:
+            if guard is not None and not guard():
+                return False
+            self._sock = sock
+            self._rfile = rfile
+            self._last_hb = time.monotonic()
+        self._connected.set()
+        threading.Thread(target=self._reader, args=(sock, rfile),
+                         name=f"dstpu-reader-{self.name}",
+                         daemon=True).start()
+        return True
+
+    def _reader(self, sock: socket.socket, rfile) -> None:
         try:
             while True:
                 frame = recv_frame(rfile)
                 if frame is None:
-                    raise ConnectionError("worker closed the socket")
+                    raise ConnectionError("peer closed the socket")
                 self._dispatch(frame)
         except (ConnectionError, OSError, ValueError, json.JSONDecodeError) \
                 as e:
             with self._lock:
-                deliberate = self._stopping or proc is not self._proc
+                # a stop()/kill()/re-attach swapped the socket out from
+                # under us: this reader's death is deliberate, not news
+                deliberate = self._stopping or sock is not self._sock
+                stopping = self._stopping
             if not deliberate:
-                self._declare_down("replica_dead")
+                self._declare_down(self._disconnect_reason())
                 logger.warning(f"serving transport: worker {self.name} "
                                f"connection lost: {e!r}")
+            elif stopping:
+                # graceful stop: the peer closing its side is the signal
+                # _await_peer_exit waits on for dial-in workers
+                self._connected.clear()
 
     def _dispatch(self, frame: Dict[str, Any]) -> None:
         ev = frame.get("ev")
@@ -394,6 +414,9 @@ class SubprocessReplica(ReplicaTransport):
             with self._lock:
                 self._last_hb = time.monotonic()
                 self._stats = frame.get("stats", {})
+                pid = frame.get("pid")
+                if pid:
+                    self._hb_pid = int(pid)
             # trace stitching (ISSUE 13): heartbeats piggyback the worker's
             # freshly-completed spans and flight-recorder events; merge
             # them into THIS process's rings so /debug/trace and flight
@@ -408,6 +431,12 @@ class SubprocessReplica(ReplicaTransport):
                     tracer.ingest_remote(spans, pid, proc_name)
                 if events:
                     recorder.ingest_events(events, pid)
+            return
+        if ev in ("swap_ok", "swap_err"):
+            with self._lock:
+                ctrl_q = self._ctrl.get(frame.get("cid"))
+            if ctrl_q is not None:
+                ctrl_q.put(frame)
             return
         rid = frame.get("rid")
         if ev in ("accepted", "rejected"):
@@ -435,30 +464,31 @@ class SubprocessReplica(ReplicaTransport):
 
     def _declare_down(self, reason: str, from_spawn: bool = False) -> None:
         """Idempotent death transition: fail in-flight streams (the
-        balancer fails them over), tear the process group down, leave a
-        flight-recorder dump."""
+        balancer fails them over), tear the peer down, leave a
+        flight-recorder dump.  Streams always fail with ``replica_dead``
+        whatever ``reason`` says — that is the balancer's retryable set."""
         with self._lock:
             if self._down is not None or self._stopping:
                 return
             self._down = reason
+            self._connected.clear()
             pending = list(self._pending.values())
             acks = list(self._acks.values())
+            ctrls = list(self._ctrl.values())
             self._pending = {}
             self._acks = {}
-            proc = self._proc
+            self._ctrl = {}
             sock, self._sock = self._sock, None
             rfile, self._rfile = self._rfile, None
         for ack_q in acks:
             ack_q.put({"ev": "rejected", "etype": "stopped",
                        "detail": reason})
+        for ctrl_q in ctrls:
+            ctrl_q.put({"ev": "swap_err", "detail": reason})
         for h in pending:
             h.q.put(("err", ("replica_dead", reason)))
         self._close_io(sock, rfile)
-        if proc is not None:
-            # the worker was started in its own session: reap the whole
-            # group so engine helper processes can't outlive it
-            terminate_procs([proc], term_timeout_s=2.0, process_group=True)
-            self._close_stdout(proc)
+        self._teardown_peer(reason)
         logger.error(f"serving transport: worker {self.name} gen "
                      f"{self.generation} DOWN ({reason}); "
                      f"{len(pending)} in-flight streams failing over")
@@ -475,18 +505,9 @@ class SubprocessReplica(ReplicaTransport):
             recorder.dump(reason=f"worker_death_{self.name}")
 
     def kill(self, reason: str = "replica_dead") -> None:
-        """Hard-kill the worker process group (SIGKILL, no grace) — the
-        fault-injection-free way to simulate a worker crash."""
-        with self._lock:
-            proc = self._proc
-        if proc is not None and proc.poll() is None:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError, OSError):
-                try:
-                    proc.kill()
-                except OSError:
-                    pass
+        """Hard-kill the peer (SIGKILL, no grace) — the fault-injection-
+        free way to simulate a worker crash."""
+        self._force_kill_peer()
         self._declare_down(reason)
 
     def stop(self, drain: bool = True,
@@ -495,33 +516,41 @@ class SubprocessReplica(ReplicaTransport):
         with self._lock:
             self._stopping = True
             sock = self._sock
-            proc = self._proc
         if sock is not None:
             try:
                 send_frame(sock, {"op": "stop", "drain": drain,
                                   "timeout": timeout}, self._wlock)
             except OSError:
                 pass
-        if proc is not None:
-            deadline = time.monotonic() + timeout
-            while proc.poll() is None and time.monotonic() < deadline:
-                time.sleep(0.02)
-            terminate_procs([proc], term_timeout_s=5.0, process_group=True)
-            self._close_stdout(proc)
+        self._await_peer_exit(timeout)
         with self._lock:
             sock, self._sock = self._sock, None
             rfile, self._rfile = self._rfile, None
             pending = list(self._pending.values())
+            ctrls = list(self._ctrl.values())
             self._pending = {}
+            self._ctrl = {}
         for h in pending:
             h.q.put(("err", ("shutdown", "replica stopped")))
+        for ctrl_q in ctrls:
+            ctrl_q.put({"ev": "swap_err", "detail": "shutdown"})
         self._close_io(sock, rfile)
+        self._connected.clear()
 
     @staticmethod
     def _close_io(sock, rfile) -> None:
         """Close the socket AND its buffered reader: ``makefile`` holds an
         io-ref on the fd, so closing only the socket object would leave
-        the descriptor open until GC (the leak tests count fds)."""
+        the descriptor open until GC (the leak tests count fds).  Shut the
+        socket down first: a reader thread blocked in ``recv`` holds the
+        buffer lock that ``rfile.close()`` needs, and with a live peer
+        (fencing severs a HEALTHY connection) nothing else would ever
+        wake it — shutdown forces the EOF that releases the lock."""
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         for f in (rfile, sock):
             if f is not None:
                 try:
@@ -529,24 +558,14 @@ class SubprocessReplica(ReplicaTransport):
                 except OSError:
                     pass
 
-    def _close_stdout(self, proc: subprocess.Popen) -> None:
-        """Release the worker's stdout pipe once it has exited (the
-        connector's drain loop tolerates the close)."""
-        if proc.stdout is not None:
-            try:
-                proc.stdout.close()
-            except OSError:
-                pass
-
     # -- client surface --------------------------------------------------
 
     def healthy(self) -> bool:
         with self._lock:
-            proc = self._proc
             return (self._down is None and not self._stopping
                     and self.circuit_open is False
                     and self._connected.is_set()
-                    and proc is not None and proc.poll() is None)
+                    and self._peer_alive())
 
     def submit(self, prompt: Sequence[int], rid: Optional[str] = None,
                **kwargs):
@@ -627,6 +646,42 @@ class SubprocessReplica(ReplicaTransport):
             return False
         return True
 
+    # -- control ops (rolling rollout) -----------------------------------
+
+    def _control(self, msg: Dict[str, Any],
+                 timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Send a control op and wait for its ``cid``-keyed ack."""
+        timeout = 60.0 if timeout is None else timeout
+        cid = f"c{next(self._rid_counter)}"
+        ctrl_q: "queue.Queue" = queue.Queue()
+        with self._lock:
+            if self._down is not None or self._stopping or self._sock is None:
+                raise BrokerStoppedError(f"replica {self.name} not accepting")
+            self._ctrl[cid] = ctrl_q
+            sock = self._sock
+        try:
+            send_frame(sock, dict(msg, cid=cid), self._wlock)
+            return ctrl_q.get(timeout=timeout)
+        except (OSError, queue.Empty) as e:
+            raise RequestFailedError(
+                "swap_failed",
+                f"replica {self.name} control {msg.get('op')!r}: {e!r}")
+        finally:
+            with self._lock:
+                self._ctrl.pop(cid, None)
+
+    def swap(self, ckpt_dir: str, timeout: Optional[float] = None) -> None:
+        """Pointer-swap the worker's params to a committed checkpoint.
+        The caller (``serving/rollout.py``) quiesces + drains first."""
+        reply = self._control({"op": "swap", "ckpt_dir": ckpt_dir}, timeout)
+        if reply.get("ev") != "swap_ok":
+            raise RequestFailedError("swap_failed", reply.get("detail", ""))
+
+    def swap_rollback(self, timeout: Optional[float] = None) -> None:
+        reply = self._control({"op": "swap_rollback"}, timeout)
+        if reply.get("ev") != "swap_ok":
+            raise RequestFailedError("swap_failed", reply.get("detail", ""))
+
     # -- stats (heartbeat-carried; never raises on a dead worker) --------
 
     def _stat(self, key: str, default=0):
@@ -661,18 +716,18 @@ class SubprocessReplica(ReplicaTransport):
     def liveness(self) -> Dict[str, Any]:
         now = time.monotonic()
         with self._lock:
-            proc = self._proc
             return {
                 "down": self._down,
                 "stopping": self._stopping,
                 "connected": self._connected.is_set(),
-                "alive": proc is not None and proc.poll() is None,
-                "pid": None if proc is None else proc.pid,
+                "alive": self._peer_alive(),
+                "pid": self._peer_pid(),
                 "hb_age": (now - self._last_hb) if self._last_hb else 0.0,
                 "progress_age": float(self._stats.get("progress_age", 0.0)),
                 "busy": bool(self._stats.get("busy", False)),
                 "broker_healthy": bool(self._stats.get("healthy", True)),
                 "spawn_age": now - self.spawn_ts,
+                "lease_remaining": self._lease_remaining(now),
             }
 
     def mark_down(self, reason: str) -> None:
@@ -686,3 +741,171 @@ class SubprocessReplica(ReplicaTransport):
                 "consecutive_failures": self.consecutive_failures,
                 "circuit_open": self.circuit_open,
                 "down_reason": live["down"]}
+
+
+class SubprocessReplica(FramedReplica):
+    """A replica living in its own process (its own XLA runtime), reached
+    over the length-prefixed socket protocol.  Restartable: after a death
+    the supervisor calls :meth:`respawn` and the same object serves the
+    next worker generation (the pool's routing indexes stay stable).
+
+    ``worker_argv`` is the ``python -m deepspeed_tpu.serving.worker``
+    argument list describing the engine (model, geometry, caching/spec
+    flags); ``extra_env`` is merged into the worker environment on every
+    (re)spawn — chaos tests use it to arm persistent ``DSTPU_FAULTS``."""
+
+    transport = "subprocess"
+
+    def __init__(self, worker_argv: Sequence[str], config: ServingConfig,
+                 name: str = "replica0",
+                 metrics: Optional[ServingMetrics] = None,
+                 extra_env: Optional[Dict[str, str]] = None):
+        super().__init__(config, name, metrics=metrics)
+        self.worker_argv = list(worker_argv)
+        self.extra_env = dict(extra_env or {})
+        self._proc: Optional[subprocess.Popen] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "SubprocessReplica":
+        """Spawn the worker and return immediately; a connector thread
+        waits for the ready line and wires the socket.  ``healthy()``
+        flips true once connected (use ``ReplicaPool.wait_ready``)."""
+        with self._lock:
+            if self._proc is not None and self._down is None:
+                return self
+            self._down = None
+            self._stopping = False
+            self._connected.clear()
+            self._pending = {}
+            self._acks = {}
+            self._ctrl = {}
+            self._stats = {}
+            self.lease_escalated = False
+            self.spawn_ts = time.monotonic()
+        env = dict(os.environ)
+        # the worker must import deepspeed_tpu regardless of caller cwd
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        prev = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + prev) if prev \
+            else pkg_root
+        env.update(self.extra_env)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "deepspeed_tpu.serving.worker",
+             "--name", f"{self.name}.g{self.generation}",
+             "--heartbeat_interval_s", str(self.cfg.heartbeat_interval_s),
+             *self.worker_argv],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, start_new_session=True)
+        with self._lock:
+            self._proc = proc
+        logger.info(f"serving transport: spawned worker {self.name} "
+                    f"gen {self.generation} pid {proc.pid}")
+        tracer.add_event("replica/spawn",
+                         attrs={"replica": self.name, "pid": proc.pid,
+                                "generation": self.generation})
+        recorder.record_event("replica/spawn", replica=self.name,
+                              pid=proc.pid, generation=self.generation)
+        if self.metrics is not None:
+            self.metrics.record_fleet(
+                "respawns" if self.generation else "spawns")
+        threading.Thread(target=self._connector, args=(proc,),
+                         name=f"dstpu-connect-{self.name}",
+                         daemon=True).start()
+        return self
+
+    def respawn(self) -> "SubprocessReplica":
+        """Next worker generation after a death (supervisor-driven)."""
+        with self._lock:
+            self.generation += 1
+            self._proc = None  # previous generation already reaped
+        return self.start()
+
+    def _connector(self, proc: subprocess.Popen) -> None:
+        """Wait for the worker's ready line, connect, then keep draining
+        worker stdout (its logs) so the pipe can never fill and block it."""
+        deadline = self.spawn_ts + self.cfg.spawn_timeout_s
+        addr = None
+        try:
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    rc = proc.poll()
+                    raise RuntimeError(f"worker exited rc={rc} before ready")
+                if READY_MARKER in line:
+                    addr = line.split(READY_MARKER, 1)[1].strip()
+                    break
+                logger.debug(f"worker[{self.name}]: {line.rstrip()}")
+            if addr is None:
+                raise TimeoutError(
+                    f"worker not ready in {self.cfg.spawn_timeout_s:.0f}s")
+            host, port = addr.rsplit(":", 1)
+            sock = socket.create_connection((host, int(port)), timeout=30.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            rfile = sock.makefile("rb")
+            if not self._wire(sock, rfile, guard=lambda: (
+                    self._down is None and proc is self._proc)):
+                self._close_io(sock, rfile)
+                return
+        except Exception as e:
+            logger.error(f"serving transport: worker {self.name} spawn "
+                         f"failed: {e!r}")
+            self._declare_down(f"spawn_failed: {e}", from_spawn=True)
+            return
+        # stdout drain (post-ready): worker logs route to our logger
+        try:
+            for line in proc.stdout:
+                logger.debug(f"worker[{self.name}]: {line.rstrip()}")
+        except (OSError, ValueError):
+            pass
+
+    # -- peer hooks ------------------------------------------------------
+
+    def _peer_alive(self) -> bool:
+        proc = self._proc
+        return proc is not None and proc.poll() is None
+
+    def _peer_pid(self) -> Optional[int]:
+        proc = self._proc
+        return None if proc is None else proc.pid
+
+    def _teardown_peer(self, reason: str) -> None:
+        proc = self._proc
+        if proc is not None:
+            # the worker was started in its own session: reap the whole
+            # group so engine helper processes can't outlive it
+            terminate_procs([proc], term_timeout_s=2.0, process_group=True)
+            self._close_stdout(proc)
+
+    def _force_kill_peer(self) -> None:
+        with self._lock:
+            proc = self._proc
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+
+    def _await_peer_exit(self, timeout: float) -> None:
+        with self._lock:
+            proc = self._proc
+        if proc is None:
+            return
+        deadline = time.monotonic() + timeout
+        while proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        terminate_procs([proc], term_timeout_s=5.0, process_group=True)
+        self._close_stdout(proc)
+
+    def _close_stdout(self, proc: subprocess.Popen) -> None:
+        """Release the worker's stdout pipe once it has exited (the
+        connector's drain loop tolerates the close)."""
+        if proc.stdout is not None:
+            try:
+                proc.stdout.close()
+            except OSError:
+                pass

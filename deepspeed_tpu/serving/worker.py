@@ -1,22 +1,36 @@
 """Out-of-process replica worker: one engine, one process, one socket.
 
-The fault-isolation unit of the serving fleet
-(``--replica_transport subprocess``).  Each worker owns a full
+The fault-isolation unit of the serving fleet.  Each worker owns a full
 :class:`~deepspeed_tpu.inference.v2.engine.InferenceEngineV2` behind a
 :class:`~deepspeed_tpu.serving.broker.RequestBroker` — its own params,
 its own paged KV, its own XLA runtime — so a segfault, OOM, wedged
-compile, or injected chaos fault costs exactly one replica.  The pool
-side of the socket is :class:`~deepspeed_tpu.serving.transport.
-SubprocessReplica`; the supervisor respawns us as ``<name>.g<N+1>``.
+compile, or injected chaos fault costs exactly one replica.  Two ways a
+worker meets its pool:
 
-Startup handshake: bind ``127.0.0.1:<ephemeral>``, print
-``dstpu-worker listening on HOST:PORT`` (the parent greps for it), accept
-exactly one connection.  After that, three thread roles:
+* **listen mode** (``--replica_transport subprocess``): bind
+  ``127.0.0.1:<ephemeral>``, print ``dstpu-worker listening on
+  HOST:PORT`` (the parent greps for it), accept exactly one connection.
+  The pool side is :class:`~deepspeed_tpu.serving.transport.
+  SubprocessReplica`; the supervisor respawns us as ``<name>.g<N+1>``.
+* **connect mode** (``--connect HOST:PORT``, the multi-host fleet): dial
+  the pool's registry and send an authenticated hello carrying our
+  fencing ``--epoch`` (token from ``$DSTPU_FLEET_TOKEN``, never argv).
+  On a dropped connection we reconnect with decorrelated-jitter backoff,
+  proving continuity with ``prev_epoch``; a ``hello_err`` means our
+  epoch is stale — some newer registration owns the slot — and the only
+  correct move is to **exit** (rc 3), because a fenced zombie's epoch
+  only gets staler.  The pool side is :class:`~deepspeed_tpu.serving.
+  remote.RemoteReplica`.
 
-* **main**: reader loop over ``submit`` / ``cancel`` / ``fault`` /
-  ``stop`` ops (frame format: ``serving/transport.py``).
+Per-connection thread roles (both modes):
+
+* **reader**: op loop over ``submit`` / ``cancel`` / ``fault`` /
+  ``swap`` / ``swap_rollback`` / ``stop`` (frame format:
+  ``serving/transport.py``);
 * **heartbeat**: every ``--heartbeat_interval_s``, one ``hb`` frame with
-  the stats the pool's routing, gauges, and hung-replica detection need.
+  the stats the pool's routing, gauges, and hung-replica detection need
+  (plus piggybacked trace spans / flight events — cursors persist
+  across reconnects, so nothing is re-sent or lost on a blip);
 * **pump** (per request): forwards the broker's token stream as ``tok``
   frames, then ``done`` / ``err``.
 
@@ -30,6 +44,8 @@ Chaos sites (``utils/faults``), all reachable via the parent's
 * ``serving.worker.hang`` — the heartbeat thread sleeps forever: beats
   stop while the process stays alive (missed-beat detection);
 * ``serving.worker.heartbeat`` — ``delay`` kind: slow heartbeats;
+* ``serving.worker.swap`` — fires inside the swap op (mid-rollout crash
+  tests);
 * ``serving.step`` (in the broker loop) — ``hang`` kind wedges the
   engine thread itself: beats keep flowing but ``progress_age`` grows
   while ``busy`` (hung-replica detection).
@@ -48,11 +64,22 @@ from typing import Optional
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
 from ..utils import faults
+from ..utils.backoff import decorrelated_jitter
 from ..utils.logging import logger
 from .broker import (BrokerStoppedError, InvalidRequestError, QueueFullError,
                      RequestBroker, RequestFailedError)
 from .config import ServingConfig
-from .transport import READY_MARKER, recv_frame, send_frame
+from .transport import (FLEET_MAGIC, PROTO_VERSION, READY_MARKER,
+                        recv_frame, send_frame)
+
+#: dial-in reconnect pacing (decorrelated jitter; resets after a healthy
+#: connection) — fast enough to ride out a blip inside the lease TTL
+_RECONNECT_BASE_S = 0.2
+_RECONNECT_CAP_S = 5.0
+#: hello send → reply budget on the worker side (the registry has its own)
+_HELLO_TIMEOUT_S = 10.0
+#: exit code for a fenced/stale registration (deliberate, non-respawnable)
+EXIT_FENCED = 3
 
 
 def _stats(broker: RequestBroker) -> dict:
@@ -92,8 +119,10 @@ def _pump(conn: socket.socket, wlock: threading.Lock, rid: str,
 class _HeartbeatState:
     """Cursors for the span / flight-event batches piggybacked on
     heartbeat frames (ISSUE 13 trace stitching).  One instance per worker
-    connection; the final graceful-stop flush shares it with the
-    heartbeat thread, so frame building is serialized."""
+    PROCESS, shared across reconnects, so the cursors keep advancing and
+    a blip neither re-sends nor drops telemetry; the final graceful-stop
+    flush shares it with the heartbeat thread, so frame building is
+    serialized."""
 
     def __init__(self, name: str):
         self.name = name
@@ -130,6 +159,270 @@ def _heartbeat_loop(conn: socket.socket, wlock: threading.Lock,
             return  # parent gone; the reader loop handles shutdown
 
 
+def _handle_swap(conn: socket.socket, wlock: threading.Lock,
+                 broker: RequestBroker, frame: dict, name: str) -> None:
+    """Run a swap / swap_rollback control op inline on the reader thread
+    (the pool quiesced + drained us first; the heartbeat thread keeps
+    beating while the checkpoint loads)."""
+    cid = frame.get("cid")
+    op = frame.get("op")
+    try:
+        faults.maybe_fail("serving.worker.swap")
+        if op == "swap":
+            from .rollout import load_swap_params  # lazy: import cycle
+
+            logger.info(f"worker {name}: swapping params from "
+                        f"{frame.get('ckpt_dir')}")
+            broker.swap_params(
+                load_swap_params(frame["ckpt_dir"], broker.engine))
+        else:
+            logger.info(f"worker {name}: rolling params back")
+            broker.swap_rollback()
+    except Exception as e:  # noqa: BLE001 — a failed swap must reach the
+        # rollout controller as a typed ack, not kill the worker
+        logger.error(f"worker {name}: {op} failed: {e!r}")
+        try:
+            send_frame(conn, {"ev": "swap_err", "cid": cid,
+                              "detail": repr(e)}, wlock)
+        except OSError:
+            pass
+    else:
+        try:
+            send_frame(conn, {"ev": "swap_ok", "cid": cid}, wlock)
+        except OSError:
+            pass
+
+
+def _serve_conn(conn: socket.socket, broker: RequestBroker, name: str,
+                heartbeat_interval_s: float, stop_evt: threading.Event,
+                hb_state: _HeartbeatState, rfile=None) -> dict:
+    """Op loop over one established connection until EOF / stop / SIGTERM.
+    Returns ``{"exit": bool, "drain": ..., "timeout": ...}`` — ``exit``
+    True means the pool told us to stop; False means the connection
+    dropped (connect mode reconnects).  ``rfile`` is the connection's
+    buffered reader when the caller already made one (the dial-in hello
+    may have buffered op frames past the reply — a second ``makefile``
+    would drop them)."""
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    if rfile is None:
+        rfile = conn.makefile("rb")
+    wlock = threading.Lock()
+    hb_stop = threading.Event()
+    hb_thread = threading.Thread(
+        target=_heartbeat_loop,
+        args=(conn, wlock, broker, heartbeat_interval_s, hb_stop, hb_state),
+        name="dstpu-worker-hb", daemon=True)
+    hb_thread.start()
+    result = {"exit": False, "drain": False, "timeout": 5.0}
+    try:
+        while not stop_evt.is_set():
+            try:
+                frame = recv_frame(rfile)
+            except (ConnectionError, OSError):
+                frame = None
+            if frame is None:
+                break  # peer closed (or died)
+            op = frame.get("op")
+            if op == "submit":
+                rid = frame["rid"]
+                trace_ctx = frame.get("trace") or {}
+                try:
+                    handle = broker.submit(
+                        prompt=frame["prompt"],
+                        max_new_tokens=frame.get("max_new_tokens"),
+                        temperature=frame.get("temperature"),
+                        deadline_s=frame.get("deadline_s"),
+                        stop_token_ids=frame.get("stop_token_ids", ()),
+                        rid=rid,
+                        trace_id=trace_ctx.get("trace_id"))
+                except QueueFullError as e:
+                    send_frame(conn, {"ev": "rejected", "rid": rid,
+                                      "etype": "queue_full",
+                                      "detail": str(e)}, wlock)
+                except InvalidRequestError as e:
+                    send_frame(conn, {"ev": "rejected", "rid": rid,
+                                      "etype": "invalid",
+                                      "detail": str(e)}, wlock)
+                except BrokerStoppedError as e:
+                    send_frame(conn, {"ev": "rejected", "rid": rid,
+                                      "etype": "stopped",
+                                      "detail": str(e)}, wlock)
+                else:
+                    send_frame(conn, {"ev": "accepted", "rid": rid}, wlock)
+                    threading.Thread(target=_pump,
+                                     args=(conn, wlock, rid, handle),
+                                     name=f"dstpu-pump-{rid}",
+                                     daemon=True).start()
+            elif op == "cancel":
+                broker.cancel(frame.get("rid", ""))
+            elif op == "fault":
+                # chaos hook: arm fault sites inside THIS worker process
+                spec = frame.get("spec") or {}
+                logger.warning(f"worker {name}: arming faults {spec}")
+                faults.configure(spec)
+            elif op in ("swap", "swap_rollback"):
+                _handle_swap(conn, wlock, broker, frame, name)
+            elif op == "stop":
+                result = {"exit": True,
+                          "drain": bool(frame.get("drain", True)),
+                          "timeout": frame.get("timeout", 30.0)}
+                break
+            else:
+                logger.warning(f"worker {name}: unknown op {op!r}")
+    finally:
+        hb_stop.set()
+    if stop_evt.is_set():
+        result["exit"] = True  # SIGTERM: treat like a no-drain stop
+    return result
+
+
+def _finish(conn: socket.socket, broker: RequestBroker,
+            hb_state: _HeartbeatState, result: dict, name: str) -> int:
+    """Graceful exit: drain per the stop op, flush telemetry, close."""
+    broker.stop(drain=result["drain"], timeout=result["timeout"])
+    # final span/event flush: drained requests finalize during stop(), and
+    # their timelines must reach the front before the socket closes
+    try:
+        send_frame(conn, hb_state.frame(broker), threading.Lock())
+    except OSError:
+        pass
+    try:
+        conn.close()
+    except OSError:
+        pass
+    logger.info(f"worker {name}: exited cleanly")
+    return 0
+
+
+def _install_sigterm(holder: dict, stop_evt: threading.Event) -> None:
+    def _sigterm(signum, frame):
+        # group-wide teardown (os.killpg from the parent): unblock the
+        # reader by shutting the read side down; teardown runs in main
+        stop_evt.set()
+        conn = holder.get("conn")
+        if conn is not None:
+            try:
+                conn.shutdown(socket.SHUT_RD)
+            except OSError:
+                pass
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
+
+def _run_listen(args, broker: RequestBroker) -> int:
+    """Subprocess transport: accept exactly one connection from the
+    parent that forked us."""
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.bind((args.host, 0))
+    lsock.listen(1)
+    lsock.settimeout(300.0)
+    host, port = lsock.getsockname()
+    # the parent transport greps worker stdout for this line
+    print(f"{READY_MARKER}{host}:{port}", flush=True)
+    try:
+        conn, _ = lsock.accept()
+    except socket.timeout:
+        logger.error(f"worker {args.name}: parent never connected")
+        broker.stop(drain=False, timeout=5.0)
+        return 1
+    finally:
+        lsock.close()
+    stop_evt = threading.Event()
+    _install_sigterm({"conn": conn}, stop_evt)
+    hb_state = _HeartbeatState(args.name)
+    logger.info(f"worker {args.name}: serving on {host}:{port}")
+    result = _serve_conn(conn, broker, args.name,
+                         args.heartbeat_interval_s, stop_evt, hb_state)
+    return _finish(conn, broker, hb_state, result, args.name)
+
+
+def _dial(args, epoch: Optional[int], prev_epoch: Optional[int]):
+    """One registration attempt: connect, hello, await the verdict.
+    Returns ``(conn, rfile, granted_epoch)``; raises ``ConnectionError``
+    on transport trouble (retryable) and ``PermissionError`` on an
+    explicit rejection (fatal: our epoch can only get staler)."""
+    host, port = args.connect.rsplit(":", 1)
+    conn = socket.create_connection((host, int(port)), timeout=10.0)
+    try:
+        conn.settimeout(_HELLO_TIMEOUT_S)
+        hello = {"op": "hello", "magic": FLEET_MAGIC,
+                 "version": PROTO_VERSION, "name": args.name,
+                 "pid": os.getpid()}
+        token = os.environ.get("DSTPU_FLEET_TOKEN")
+        if token:
+            hello["token"] = token
+        if prev_epoch is not None:
+            hello["prev_epoch"] = prev_epoch
+        elif epoch is not None:
+            hello["epoch"] = epoch
+        send_frame(conn, hello)
+        rfile = conn.makefile("rb")
+        reply = recv_frame(rfile)
+    except socket.timeout as e:
+        conn.close()
+        raise ConnectionError(f"hello timed out: {e}")
+    except (ConnectionError, OSError):
+        conn.close()
+        raise
+    if reply is None:
+        conn.close()
+        raise ConnectionError("registry closed during hello")
+    if reply.get("ev") != "hello_ok":
+        conn.close()
+        raise PermissionError(reply.get("reason", "rejected"))
+    conn.settimeout(None)
+    return conn, rfile, int(reply["epoch"])
+
+
+def _run_connect(args, broker: RequestBroker) -> int:
+    """Fleet transport: dial the registry, serve, reconnect on blips,
+    exit for good on a stop op or a fencing rejection."""
+    stop_evt = threading.Event()
+    holder: dict = {"conn": None}
+    _install_sigterm(holder, stop_evt)
+    hb_state = _HeartbeatState(args.name)
+    granted: Optional[int] = None  # last epoch the registry gave us
+    sleep_s = _RECONNECT_BASE_S
+    while not stop_evt.is_set():
+        try:
+            conn, rfile, granted = _dial(
+                args, epoch=args.epoch if granted is None else None,
+                prev_epoch=granted)
+        except PermissionError as e:
+            logger.error(f"worker {args.name}: registration rejected "
+                         f"({e}) — exiting, not retrying")
+            broker.stop(drain=False, timeout=5.0)
+            return EXIT_FENCED
+        except (ConnectionError, OSError) as e:
+            sleep_s = decorrelated_jitter(_RECONNECT_BASE_S,
+                                          _RECONNECT_CAP_S, sleep_s)
+            logger.warning(f"worker {args.name}: registry unreachable "
+                           f"({e!r}); retrying in {sleep_s:.2f}s")
+            if stop_evt.wait(sleep_s):
+                break
+            continue
+        sleep_s = _RECONNECT_BASE_S  # healthy connection: reset pacing
+        holder["conn"] = conn
+        logger.info(f"worker {args.name}: registered with {args.connect} "
+                    f"(epoch {granted})")
+        result = _serve_conn(conn, broker, args.name,
+                             args.heartbeat_interval_s, stop_evt, hb_state,
+                             rfile=rfile)
+        holder["conn"] = None
+        if result["exit"]:
+            return _finish(conn, broker, hb_state, result, args.name)
+        # connection dropped: keep the engine hot and dial back in — the
+        # pool holds our lease open for lease_ttl_s
+        try:
+            conn.close()
+        except OSError:
+            pass
+        logger.warning(f"worker {args.name}: connection to pool lost; "
+                       f"reconnecting")
+    broker.stop(drain=False, timeout=5.0)
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     from .server import add_engine_cli_args, add_serving_cli_args, \
         build_engine_factory
@@ -139,6 +432,12 @@ def main(argv: Optional[list] = None) -> int:
         description="deepspeed_tpu out-of-process replica worker")
     p.add_argument("--name", default="replica0.g0")
     p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--connect", default=None, metavar="HOST:PORT",
+                   help="dial in to a pool registry instead of listening "
+                        "(multi-host fleet mode)")
+    p.add_argument("--epoch", type=int, default=None,
+                   help="fencing epoch for the first registration "
+                        "(launcher-assigned; reconnects negotiate)")
     p.add_argument("--heartbeat_interval_s", type=float, default=0.25)
     add_engine_cli_args(p)
     add_serving_cli_args(p)
@@ -165,112 +464,9 @@ def main(argv: Optional[list] = None) -> int:
                            name=args.name)
     broker.start()
 
-    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    lsock.bind((args.host, 0))
-    lsock.listen(1)
-    lsock.settimeout(300.0)
-    host, port = lsock.getsockname()
-    # the parent transport greps worker stdout for this line
-    print(f"{READY_MARKER}{host}:{port}", flush=True)
-    try:
-        conn, _ = lsock.accept()
-    except socket.timeout:
-        logger.error(f"worker {args.name}: parent never connected")
-        broker.stop(drain=False, timeout=5.0)
-        return 1
-    finally:
-        lsock.close()
-    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    rfile = conn.makefile("rb")
-    wlock = threading.Lock()
-    stop_evt = threading.Event()
-    drain_on_stop = {"drain": False, "timeout": 5.0}
-
-    def _sigterm(signum, frame):
-        # group-wide teardown (os.killpg from the parent): unblock the
-        # reader by shutting the read side down; teardown runs below
-        stop_evt.set()
-        try:
-            conn.shutdown(socket.SHUT_RD)
-        except OSError:
-            pass
-
-    signal.signal(signal.SIGTERM, _sigterm)
-    hb_state = _HeartbeatState(args.name)
-    threading.Thread(
-        target=_heartbeat_loop,
-        args=(conn, wlock, broker, args.heartbeat_interval_s, stop_evt,
-              hb_state),
-        name="dstpu-worker-hb", daemon=True).start()
-    logger.info(f"worker {args.name}: serving on {host}:{port}")
-
-    while not stop_evt.is_set():
-        try:
-            frame = recv_frame(rfile)
-        except (ConnectionError, OSError):
-            frame = None
-        if frame is None:
-            break  # parent closed (or died): exit; the group reaper
-            # would get us anyway, but exiting frees the engine now
-        op = frame.get("op")
-        if op == "submit":
-            rid = frame["rid"]
-            trace_ctx = frame.get("trace") or {}
-            try:
-                handle = broker.submit(
-                    prompt=frame["prompt"],
-                    max_new_tokens=frame.get("max_new_tokens"),
-                    temperature=frame.get("temperature"),
-                    deadline_s=frame.get("deadline_s"),
-                    stop_token_ids=frame.get("stop_token_ids", ()),
-                    rid=rid,
-                    trace_id=trace_ctx.get("trace_id"))
-            except QueueFullError as e:
-                send_frame(conn, {"ev": "rejected", "rid": rid,
-                                  "etype": "queue_full", "detail": str(e)},
-                           wlock)
-            except InvalidRequestError as e:
-                send_frame(conn, {"ev": "rejected", "rid": rid,
-                                  "etype": "invalid", "detail": str(e)},
-                           wlock)
-            except BrokerStoppedError as e:
-                send_frame(conn, {"ev": "rejected", "rid": rid,
-                                  "etype": "stopped", "detail": str(e)},
-                           wlock)
-            else:
-                send_frame(conn, {"ev": "accepted", "rid": rid}, wlock)
-                threading.Thread(target=_pump,
-                                 args=(conn, wlock, rid, handle),
-                                 name=f"dstpu-pump-{rid}",
-                                 daemon=True).start()
-        elif op == "cancel":
-            broker.cancel(frame.get("rid", ""))
-        elif op == "fault":
-            # chaos hook: arm fault sites inside THIS worker generation
-            spec = frame.get("spec") or {}
-            logger.warning(f"worker {args.name}: arming faults {spec}")
-            faults.configure(spec)
-        elif op == "stop":
-            drain_on_stop = {"drain": bool(frame.get("drain", True)),
-                             "timeout": frame.get("timeout", 30.0)}
-            break
-        else:
-            logger.warning(f"worker {args.name}: unknown op {op!r}")
-
-    stop_evt.set()
-    broker.stop(**drain_on_stop)
-    # final span/event flush: drained requests finalize during stop(), and
-    # their timelines must reach the front before the socket closes
-    try:
-        send_frame(conn, hb_state.frame(broker), wlock)
-    except OSError:
-        pass
-    try:
-        conn.close()
-    except OSError:
-        pass
-    logger.info(f"worker {args.name}: exited cleanly")
-    return 0
+    if args.connect:
+        return _run_connect(args, broker)
+    return _run_listen(args, broker)
 
 
 if __name__ == "__main__":

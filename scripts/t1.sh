@@ -135,6 +135,17 @@ then
     exit 2
 fi
 
+# multi-host fleet suite: imports the network transport (remote registry,
+# fenced registration), the autoscaler, and the rolling-rollout controller
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_remote_fleet.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_remote_fleet.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi
@@ -149,7 +160,10 @@ fi
 # is unaffected (tier-1 tests are file-independent; conftest re-creates
 # fixtures per process); DOTS_PASSED aggregates across groups.
 T1_GROUPS=${T1_GROUPS:-6}
-mapfile -t T1_FILES < <(ls tests/test_*.py | sort)
+# test_remote_fleet gets its own partition (appended below): its loopback-
+# TCP fleets bind ephemeral registry ports and spawn scripted worker
+# processes, and must not share a pytest process with engine-heavy suites
+mapfile -t T1_FILES < <(ls tests/test_*.py | grep -v 'test_remote_fleet' | sort)
 rc=0
 rm -f /tmp/_t1.log
 for ((g = 0; g < T1_GROUPS; g++)); do
@@ -171,5 +185,14 @@ for ((g = 0; g < T1_GROUPS; g++)); do
         rc=$grc
     fi
 done
+echo "== t1: group remote-fleet: tests/test_remote_fleet.py =="
+timeout -k 10 1800 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_remote_fleet.py -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
+grc=${PIPESTATUS[0]}
+if [ "$grc" -ne 0 ] && [ "$grc" -ne 5 ]; then
+    rc=$grc
+fi
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 exit $rc

@@ -1,0 +1,221 @@
+"""Protocol-only fleet worker for fast loopback tests (no JAX, no engine).
+
+Speaks the exact dial-in wire protocol of ``deepspeed_tpu.serving.worker``
+— versioned/authenticated hello with fencing epochs, heartbeats, submit/
+tok/done streaming, reconnect with ``prev_epoch``, exit 3 on a fencing
+rejection — but generates tokens from a fixed function of the prompt
+instead of running a model.  Spawn cost is ~0.1s instead of a JAX import
+plus an engine compile, so registry/fencing/failover tests can afford
+real processes and real TCP.
+
+Determinism contract (shared with the tests): token ``i`` for ``prompt``
+is ``(sum(prompt) + 31 * i) % 97``.  Every instance agrees, so a stream
+that fails over mid-flight to another scripted worker must come back
+token-identical — the same property the real fleet proves under greedy
+decode.
+
+Chaos knob: ``--drop_after_toks N`` hard-closes the socket after the
+N-th token frame of the FIRST connection (one-shot), then reconnects
+with ``prev_epoch`` like a worker riding out a network blip.
+"""
+
+import argparse
+import json
+import os
+import random
+import socket
+import struct
+import sys
+import threading
+import time
+
+_LEN = struct.Struct(">I")
+FLEET_MAGIC = "dstpu-fleet"
+PROTO_VERSION = 1
+EXIT_FENCED = 3
+
+
+def send_frame(sock, frame, lock=None):
+    payload = json.dumps(frame, separators=(",", ":")).encode()
+    data = _LEN.pack(len(payload)) + payload
+    if lock is not None:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+
+
+def recv_frame(rfile):
+    head = rfile.read(_LEN.size)
+    if len(head) < _LEN.size:
+        return None
+    (n,) = _LEN.unpack(head)
+    payload = rfile.read(n)
+    if len(payload) < n:
+        return None
+    return json.loads(payload.decode())
+
+
+def scripted_tokens(prompt, n):
+    base = sum(int(t) for t in prompt)
+    return [(base + 31 * i) % 97 for i in range(n)]
+
+
+class Worker:
+    def __init__(self, args):
+        self.args = args
+        self.drop_budget = args.drop_after_toks  # 0 = never drop
+        self.active = {}  # rid -> threading.Event (cancel flag)
+        self.lock = threading.Lock()
+
+    # -- streaming --------------------------------------------------------
+
+    def _stream(self, conn, wlock, rid, prompt, n):
+        cancel = self.active[rid]
+        toks_sent = 0
+        try:
+            for tok in scripted_tokens(prompt, n):
+                if cancel.is_set():
+                    send_frame(conn, {"ev": "err", "rid": rid,
+                                      "reason": "cancelled",
+                                      "detail": "cancelled"}, wlock)
+                    return
+                time.sleep(self.args.tok_delay_s)
+                send_frame(conn, {"ev": "tok", "rid": rid, "toks": [tok]},
+                           wlock)
+                toks_sent += 1
+                if self.drop_budget and toks_sent >= self.drop_budget:
+                    # one-shot chaos: sever the TCP connection mid-stream
+                    # (shutdown, not just close — the op-loop's makefile
+                    # holds an io-ref, so close alone would not send FIN)
+                    self.drop_budget = 0
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    conn.close()
+                    return
+            send_frame(conn, {"ev": "done", "rid": rid,
+                              "reason": "length"}, wlock)
+        except OSError:
+            pass
+        finally:
+            self.active.pop(rid, None)
+
+    def _heartbeat(self, conn, wlock, stop_evt):
+        while not stop_evt.wait(self.args.heartbeat_interval_s):
+            running = len(self.active)
+            hb = {"ev": "hb", "pid": os.getpid(), "proc": self.args.name,
+                  "stats": {"healthy": True, "busy": bool(running),
+                            "progress_age": 0.0, "queue_depth": 0,
+                            "outstanding_tokens": running,
+                            "kv_utilization": 0.0, "running": running,
+                            "waiting": 0, "prefix": {}, "spec": {}}}
+            try:
+                send_frame(conn, hb, wlock)
+            except OSError:
+                return
+
+    # -- connection lifecycle ---------------------------------------------
+
+    def _dial(self, granted):
+        host, port = self.args.connect.rsplit(":", 1)
+        conn = socket.create_connection((host, int(port)), timeout=5.0)
+        conn.settimeout(5.0)
+        hello = {"op": "hello", "magic": FLEET_MAGIC,
+                 "version": PROTO_VERSION, "name": self.args.name,
+                 "pid": os.getpid()}
+        token = os.environ.get("DSTPU_FLEET_TOKEN")
+        if token:
+            hello["token"] = token
+        if granted is not None:
+            hello["prev_epoch"] = granted
+        elif self.args.epoch is not None:
+            hello["epoch"] = self.args.epoch
+        send_frame(conn, hello)
+        rfile = conn.makefile("rb")
+        reply = recv_frame(rfile)
+        if reply is None:
+            conn.close()
+            raise ConnectionError("registry closed during hello")
+        if reply.get("ev") != "hello_ok":
+            conn.close()
+            raise PermissionError(reply.get("reason", "rejected"))
+        conn.settimeout(None)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn, rfile, int(reply["epoch"])
+
+    def _serve(self, conn, rfile):
+        """Op loop until EOF; returns True when told to stop for good."""
+        wlock = threading.Lock()
+        hb_stop = threading.Event()
+        threading.Thread(target=self._heartbeat,
+                         args=(conn, wlock, hb_stop), daemon=True).start()
+        try:
+            while True:
+                try:
+                    frame = recv_frame(rfile)
+                except OSError:
+                    frame = None
+                if frame is None:
+                    return False  # connection lost: reconnect
+                op = frame.get("op")
+                if op == "submit":
+                    rid = frame["rid"]
+                    n = int(frame.get("max_new_tokens") or 8)
+                    self.active[rid] = threading.Event()
+                    send_frame(conn, {"ev": "accepted", "rid": rid}, wlock)
+                    threading.Thread(
+                        target=self._stream,
+                        args=(conn, wlock, rid, frame["prompt"], n),
+                        daemon=True).start()
+                elif op == "cancel":
+                    ev = self.active.get(frame.get("rid", ""))
+                    if ev is not None:
+                        ev.set()
+                elif op in ("swap", "swap_rollback"):
+                    send_frame(conn, {"ev": "swap_ok",
+                                      "cid": frame.get("cid")}, wlock)
+                elif op == "stop":
+                    return True
+                # fault and unknown ops: ignore
+        finally:
+            hb_stop.set()
+
+    def run(self):
+        granted = None
+        sleep_s = 0.05
+        while True:
+            try:
+                conn, rfile, granted = self._dial(granted)
+            except PermissionError as e:
+                print(f"scripted_worker {self.args.name}: rejected ({e})",
+                      file=sys.stderr, flush=True)
+                return EXIT_FENCED
+            except (ConnectionError, OSError):
+                sleep_s = min(1.0, sleep_s * 2) * (0.5 + random.random())
+                time.sleep(sleep_s)
+                continue
+            sleep_s = 0.05
+            stop = self._serve(conn, rfile)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if stop:
+                return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="scripted-worker")
+    p.add_argument("--connect", required=True, metavar="HOST:PORT")
+    p.add_argument("--name", default="replica0")
+    p.add_argument("--epoch", type=int, default=None)
+    p.add_argument("--heartbeat_interval_s", type=float, default=0.05)
+    p.add_argument("--tok_delay_s", type=float, default=0.02)
+    p.add_argument("--drop_after_toks", type=int, default=0)
+    return Worker(p.parse_args(argv)).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -190,7 +190,7 @@ def test_decorrelated_jitter_backoff_bounds(monkeypatch):
     cfg = ServingConfig(retry_backoff_s=0.05, retry_backoff_max_s=2.0)
     h = BalancedHandle(_FakePool(cfg), None, 0, {})
     # upper envelope: uniform returns its hi bound → 3x growth, capped
-    monkeypatch.setattr("deepspeed_tpu.serving.balancer.random.uniform",
+    monkeypatch.setattr("deepspeed_tpu.utils.backoff.random.uniform",
                         lambda lo, hi: hi)
     seq, prev = [], cfg.retry_backoff_s
     for _ in range(8):
@@ -201,7 +201,7 @@ def test_decorrelated_jitter_backoff_bounds(monkeypatch):
     assert max(seq) == cfg.retry_backoff_max_s  # cap reached and held
     assert seq[-1] == cfg.retry_backoff_max_s
     # lower envelope: uniform returns its lo bound → never below base
-    monkeypatch.setattr("deepspeed_tpu.serving.balancer.random.uniform",
+    monkeypatch.setattr("deepspeed_tpu.utils.backoff.random.uniform",
                         lambda lo, hi: lo)
     assert h._backoff(1.7) == cfg.retry_backoff_s
     # real draws stay inside [base, cap]
