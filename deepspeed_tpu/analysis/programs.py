@@ -184,9 +184,14 @@ def _decode_v2_artifact(name: str, **v2_extra: Any) -> ProgramArtifact:
     positions = np.zeros((seqs,), np.int32)
     tables = np.zeros((seqs, v2.max_blocks_per_seq), np.int32)
     ctx_lens = np.ones((seqs,), np.int32)
+    # per-row sampling rides inside the decode program (temps/rng/seeds):
+    # the budget proves a mixed greedy/sampled batch still runs with zero
+    # host syncs and the KV caches aliased in place
+    temps = np.zeros((seqs,), np.float32)
+    seeds = np.zeros((seqs,), np.int32)
     compiled = eng._decode_fwd.lower(
-        eng.params, eng.caches, tokens, positions, tables,
-        ctx_lens).compile()
+        eng.params, eng.caches, tokens, positions, tables, ctx_lens,
+        temps, jax.random.PRNGKey(0), seeds).compile()
     ctx = AnalysisContext(
         program=name,
         compute_dtype="bf16",
@@ -218,7 +223,6 @@ def _decode_v2_quant_program() -> ProgramArtifact:
 
 def _spec_decode_program() -> ProgramArtifact:
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from ..inference.v2.engine import InferenceEngineV2, V2Config
@@ -244,7 +248,7 @@ def _spec_decode_program() -> ProgramArtifact:
     compiled = eng._spec_fwd.lower(
         eng.params, eng.spec_heads, eng.caches, tokens, ctx_lens, tables,
         limit, hidden, jax.random.PRNGKey(0),
-        jnp.asarray(0.0, jnp.float32)).compile()
+        np.zeros((seqs,), np.float32), np.zeros((seqs,), np.int32)).compile()
     ctx = AnalysisContext(
         program="spec_decode_step@v2",
         compute_dtype="bf16",

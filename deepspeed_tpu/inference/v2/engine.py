@@ -86,6 +86,36 @@ class V2Config:
 
 
 # ---------------------------------------------------------------------------
+# per-row sampling (in-graph: the decode programs emit token ids, not logits)
+# ---------------------------------------------------------------------------
+
+
+def _row_keys(rng, seeds):
+    """One PRNG key per row: fold the request seed AND the row index into
+    the step key.  Folding the row index means two requests that picked the
+    same seed still draw independently within a batch; folding the request
+    seed means a request's sample stream survives row reassignment."""
+    rows = jnp.arange(seeds.shape[0])
+    return jax.vmap(
+        lambda s, r: jax.random.fold_in(jax.random.fold_in(rng, s), r)
+    )(seeds, rows)
+
+
+def sample_rows(logits, temps, rng, seeds):
+    """Per-row next-token selection: rows with ``temps <= 0`` take the
+    argmax (bit-identical to the pre-vectorization greedy path — the same
+    f32 logits through the same argmax); rows with ``temps > 0`` draw from
+    ``categorical(logits / temp)`` under their own fold_in key.  Both lanes
+    are computed and selected with ``jnp.where`` — no host sync, no
+    per-row control flow."""
+    greedy = logits.argmax(-1).astype(jnp.int32)
+    keys = _row_keys(rng, seeds)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+# ---------------------------------------------------------------------------
 # ragged forward (jitted once; static shapes from V2Config)
 # ---------------------------------------------------------------------------
 
@@ -246,11 +276,18 @@ def build_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
     """Pure-decode step: one token per sequence, attention through the paged
     Pallas kernel (ops/pallas/paged_attention.py) — the FastGen decode hot
     loop.  tokens/positions: (max_seqs,); context_lens INCLUDE the new token.
-    """
 
-    def fwd(params, caches, token_ids, position_ids, block_tables, context_lens):
-        return _decode_body(params, caches, token_ids, position_ids,
-                            block_tables, context_lens, model_cfg, v2)
+    Sampling happens IN-GRAPH per row (``sample_rows``): the program takes a
+    (max_seqs,) temperature vector + step rng + per-row seeds and returns the
+    selected token ids, so a mixed greedy/sampled batch is one host-sync-free
+    program (the ``decode_step@v2`` budget proves it)."""
+
+    def fwd(params, caches, token_ids, position_ids, block_tables,
+            context_lens, temps, rng, seeds):
+        logits, caches = _decode_body(params, caches, token_ids, position_ids,
+                                      block_tables, context_lens, model_cfg,
+                                      v2)
+        return sample_rows(logits, temps, rng, seeds), caches
 
     return _memo(("decode_fwd", model_cfg, dataclasses.astuple(v2)),
                  lambda: jax.jit(fwd, donate_argnums=(1,)))
@@ -263,13 +300,14 @@ def build_multi_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config,
     host roundtrip that dominates small-model decode.  Safe because admission
     reserves each sequence's whole block budget up front.
 
-    ``temperature == 0`` → greedy argmax; ``> 0`` → categorical sampling with
-    a per-step split of ``rng`` (carried through the scan).
+    Per-row sampling (``temps``/``seeds`` vectors, see ``sample_rows``) with
+    a per-step split of ``rng`` carried through the scan; rows with
+    ``temps <= 0`` stay greedy-argmax.
 
     Returns (tokens_out (num_steps, max_seqs), caches)."""
 
     def fwd(params, caches, token_ids, position_ids, block_tables, context_lens,
-            rng, temperature):
+            rng, temps, seeds):
         # rows inactive at entry must STAY inactive: advancing their ctx/pos
         # would flip them "active" with a zeroed block table and corrupt
         # block 0 of a real sequence
@@ -280,14 +318,7 @@ def build_multi_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config,
             logits, caches = _decode_body(params, caches, tok, pos,
                                           block_tables, ctx, model_cfg, v2)
             rng, step_rng = jax.random.split(rng)
-            # lax.cond: the greedy branch skips Gumbel sampling entirely
-            nxt = jax.lax.cond(
-                temperature > 0.0,
-                lambda l: jax.random.categorical(
-                    step_rng, l / jnp.maximum(temperature, 1e-6)
-                ).astype(jnp.int32),
-                lambda l: l.argmax(-1).astype(jnp.int32),
-                logits)
+            nxt = sample_rows(logits, temps, step_rng, seeds)
             return (caches, nxt, pos + alive, ctx + alive, rng), nxt
 
         (caches, _, _, _, _), toks = jax.lax.scan(
@@ -588,6 +619,97 @@ class InferenceEngineV2:
         stats["pinned_blocks"] = self.pinned_blocks
         return stats
 
+    def prefix_summary(self, max_digests: int = 1024) -> Dict[str, Any]:
+        """Radix-tree digest summary for cache-aware routing (empty when
+        the cache is off) — rides the worker heartbeat."""
+        if self.prefix_cache is None:
+            return {"block_size": self.cfg.block_size, "digests": []}
+        return self.prefix_cache.summary(max_digests)
+
+    # -- KV handoff between replica classes (disaggregated serving) -----
+
+    def export_prefix(self, tokens: List[int]) -> Optional[bytes]:
+        """Serialize the longest cached full-block prefix of ``tokens`` as
+        a safetensors payload (``io/fast_writer.py`` header format): the
+        k/v block data of the matched radix subtree plus the covered token
+        ids.  This is the unit of KV transfer between replica classes — a
+        prefill replica exports the prompt's KV, a decode replica imports
+        it and decodes from the first uncached token.  Returns ``None``
+        when nothing is cached."""
+        if self.prefix_cache is None:
+            return None
+        from ...io.fast_writer import build_safetensors_header
+
+        blocks, matched = self.prefix_cache.walk_full_blocks(tokens)
+        if not blocks:
+            return None
+        try:
+            idx = np.asarray(blocks, np.int64)
+            arrays = {
+                "k": np.ascontiguousarray(np.asarray(self.caches["k"][:, idx])),
+                "v": np.ascontiguousarray(np.asarray(self.caches["v"][:, idx])),
+            }
+            meta = {
+                "tokens": ",".join(str(int(t)) for t in tokens[:matched]),
+                "block_size": str(self.cfg.block_size),
+            }
+            header, offsets, _ = build_safetensors_header(arrays, meta)
+            parts = [header]
+            for name in arrays:  # dict order == offset order
+                parts.append(arrays[name].tobytes())
+            return b"".join(parts)
+        finally:
+            self.kv.allocator.free(blocks)  # drop the export walk's pins
+
+    def import_prefix(self, payload: bytes) -> int:
+        """Adopt an exported prefix: allocate blocks, scatter the k/v data
+        into the paged caches, and donate the chain into the radix tree.
+        Imports the longest leading run of blocks the pool can hold;
+        returns the number of prompt tokens now cached locally."""
+        if self.prefix_cache is None:
+            return 0
+        import json as _json
+
+        import ml_dtypes
+
+        hlen = int.from_bytes(payload[:8], "little")
+        hdr = _json.loads(payload[8:8 + hlen].decode())
+        data = payload[8 + hlen:]
+        meta = hdr.pop("__metadata__", {})
+        if int(meta.get("block_size", -1)) != self.cfg.block_size:
+            return 0  # block-size mismatch: not transferable
+        tokens = [int(t) for t in meta["tokens"].split(",") if t]
+        dt_map = {"BF16": ml_dtypes.bfloat16, "F32": np.float32,
+                  "F16": np.float16}
+        tensors = {}
+        for name, ent in hdr.items():
+            lo, hi = ent["data_offsets"]
+            tensors[name] = np.frombuffer(
+                data[lo:hi], dtype=dt_map[ent["dtype"]]
+            ).reshape(ent["shape"])
+        k_arr, v_arr = tensors["k"], tensors["v"]
+        n = k_arr.shape[1]
+        alloc = self.kv.allocator
+        if n > alloc.free_blocks:
+            self.prefix_cache.evict(n - alloc.free_blocks)
+        n = min(n, alloc.free_blocks)
+        if n == 0:
+            return 0
+        blocks = alloc.allocate(n)
+        idx = jnp.asarray(np.asarray(blocks, np.int64))
+        dt = jnp.dtype(self.cfg.dtype)
+        self.caches = {
+            "k": self.caches["k"].at[:, idx].set(
+                jnp.asarray(k_arr[:, :n]).astype(dt)),
+            "v": self.caches["v"].at[:, idx].set(
+                jnp.asarray(v_arr[:, :n]).astype(dt)),
+        }
+        covered = n * self.cfg.block_size
+        # donate adopts our references (or dedupes against already-cached
+        # chunks by freeing the duplicate block)
+        self.prefix_cache.donate(tokens[:covered], covered, blocks)
+        return covered
+
     def spec_stats(self) -> Dict[str, float]:
         """Speculative-decoding counters for serving metrics; ``enabled=0``
         and all-zero when ``spec_mode`` is 'off'.  ``acceptance_rate`` is
@@ -625,13 +747,18 @@ class InferenceEngineV2:
 
     # -- request API ---------------------------------------------------
     def put(self, prompt_tokens: List[int], max_new_tokens: int = 64,
-            strict: bool = False) -> int:
+            strict: bool = False, temperature: Optional[float] = None,
+            seed: int = 0) -> int:
         """Queue a request.  Raises :class:`AdmissionError` if the request
         could NEVER run (exceeds max context).  With ``strict=True`` it also
         raises when the engine cannot admit it RIGHT NOW — no free sequence
         slot, or the block pool (minus what the waiting queue has coming)
         cannot hold the full prompt+budget reservation.  A strictly-admitted
-        request is therefore guaranteed schedulable on the next step."""
+        request is therefore guaranteed schedulable on the next step.
+
+        ``temperature``/``seed`` pin THIS request's sampling row in the
+        per-row vector; ``temperature=None`` inherits whatever scalar the
+        caller passes to :meth:`step` (the pre-disaggregation behaviour)."""
         max_ctx = self.cfg.max_blocks_per_seq * self.cfg.block_size
         need = len(prompt_tokens) + max_new_tokens
         if need > max_ctx:
@@ -655,7 +782,8 @@ class InferenceEngineV2:
                     f"{self._blocks_for(need)} blocks, {avail} unreserved")
         self._uid += 1
         seq = SequenceDescriptor(uid=self._uid, tokens=list(prompt_tokens),
-                                 max_new_tokens=max_new_tokens)
+                                 max_new_tokens=max_new_tokens,
+                                 temperature=temperature, seed=seed)
         self.waiting.append(seq)
         return self._uid
 
@@ -787,6 +915,20 @@ class InferenceEngineV2:
         return (jnp.asarray(t.next_tok), jnp.asarray(t.ctx),
                 jnp.asarray(t.block_tables), jnp.asarray(ctx_in))
 
+    def _row_temps(self, temperature: float) -> jax.Array:
+        """Effective per-row temperature vector: rows whose request pinned a
+        temperature keep it; rows that didn't (temp < 0) inherit the
+        step-level scalar."""
+        t = self.table
+        return jnp.asarray(np.where(t.temp >= 0.0, t.temp,
+                                    np.float32(temperature))
+                           .astype(np.float32))
+
+    def _step_rng(self, rng: Optional[jax.Array]) -> jax.Array:
+        if rng is None:
+            self._rng, rng = jax.random.split(self._rng)
+        return rng
+
     def _advance_rows(self, sel: "np.ndarray") -> "np.ndarray":
         """Vectorized post-decode bookkeeping. ``sel``: (k, ns) new tokens
         for the active rows; retires sequences whose budget is exhausted;
@@ -810,16 +952,11 @@ class InferenceEngineV2:
         vectorized; Python touches only sequences that just completed."""
         self.fast_steps += 1
         t = self.table
-        logits, self.caches = self._decode_fwd(
-            self.params, self.caches, *self._table_inputs())
-        if temperature > 0.0:
-            if rng is None:
-                self._rng, rng = jax.random.split(self._rng)
-            sampled = jax.random.categorical(rng, logits / temperature,
-                                             axis=-1)
-        else:
-            sampled = logits.argmax(-1)
-        sampled = np.asarray(sampled)
+        toks, self.caches = self._decode_fwd(
+            self.params, self.caches, *self._table_inputs(),
+            self._row_temps(temperature), self._step_rng(rng),
+            jnp.asarray(t.seed))
+        sampled = np.asarray(toks)
         rows = np.nonzero(t.active)[0]
         sel = sampled[rows].astype(np.int32)[None, :]  # (1, ns)
         out = {t.seq_at[int(r)].uid: [int(s)] for r, s in zip(rows, sel[0])}
@@ -836,23 +973,23 @@ class InferenceEngineV2:
         self.fast_steps += 1
         self.spec_steps += 1
         t = self.table
-        if rng is None:
-            self._rng, rng = jax.random.split(self._rng)
+        rng = self._step_rng(rng)
         next_tok, ctx, block_tables, _ = self._table_inputs()
         limit = jnp.asarray(t.limit)
-        temp = jnp.asarray(temperature, jnp.float32)
+        temps = self._row_temps(temperature)
+        seeds = jnp.asarray(t.seed)
         hidden_np = None
         if self.cfg.spec_mode == "self_draft":
             emitted, alen, new_hidden, self.caches = self._spec_fwd(
                 self.params, self.spec_heads, self.caches, next_tok, ctx,
                 block_tables, limit, jnp.asarray(self._spec_hidden), rng,
-                temp)
+                temps, seeds)
             hidden_np = np.asarray(new_hidden)
         else:
             emitted, alen, self.caches, self._draft_caches = self._spec_fwd(
                 self.params, self.draft_params, self.caches,
                 self._draft_caches, next_tok, ctx, block_tables, limit, rng,
-                temp)
+                temps, seeds)
         emitted = np.asarray(emitted)  # (max_seqs, k+1)
         alen = np.asarray(alen)
         out: Dict[int, List[int]] = {}
@@ -952,13 +1089,17 @@ class InferenceEngineV2:
             # position ctx without ever re-prefilling
             _, _, self._draft_caches = self._draft_fwd(
                 self.draft_params, self._draft_caches, *batch_args)
-        if temperature > 0.0:
-            if rng is None:
-                self._rng, rng = jax.random.split(self._rng)
-            sampled = jax.random.categorical(rng, logits / temperature, axis=-1)
-        else:
-            sampled = logits.argmax(-1)
-        sampled = np.asarray(sampled)
+        # per-row selection mirrors the jitted decode path: pick rows carry
+        # their request's pinned temperature/seed, padding rows stay greedy
+        temps = np.zeros(self.cfg.max_seqs, np.float32)
+        seeds = np.zeros(self.cfg.max_seqs, np.int32)
+        for row, (seq, _) in enumerate(picks):
+            temps[row] = (temperature if seq.temperature is None
+                          else seq.temperature)
+            seeds[row] = np.int32(np.uint32(seq.seed & 0xFFFFFFFF))
+        sampled = np.asarray(sample_rows(logits, jnp.asarray(temps),
+                                         self._step_rng(rng),
+                                         jnp.asarray(seeds)))
         hidden_np = (np.asarray(hidden)
                      if self.cfg.spec_mode == "self_draft" else None)
 
@@ -993,11 +1134,10 @@ class InferenceEngineV2:
             self._multi_decode[k] = build_multi_decode_forward(
                 self.model_cfg, self.cfg, k)
         t = self.table
-        if rng is None:
-            self._rng, rng = jax.random.split(self._rng)
         toks, self.caches = self._multi_decode[k](
-            self.params, self.caches, *self._table_inputs(), rng,
-            jnp.asarray(temperature, jnp.float32))
+            self.params, self.caches, *self._table_inputs(),
+            self._step_rng(rng), self._row_temps(temperature),
+            jnp.asarray(t.seed))
         toks = np.asarray(toks)  # (k, max_seqs)
         rows = np.nonzero(t.active)[0]
         self._advance_rows(toks[:, rows].astype(np.int32))

@@ -26,9 +26,32 @@ every engine call); gauge reads from other threads only touch ints.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+import hashlib
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .ragged import BlockedAllocator
+
+
+def prefix_digests(tokens: Sequence[int], block_size: int,
+                   max_chunks: Optional[int] = None) -> List[str]:
+    """Cumulative per-chunk digests of a token prefix: ``d_i = blake2b(
+    d_{i-1} || chunk_i)``.  blake2b (not Python ``hash``) because the
+    digests cross process boundaries — the balancer compares a request's
+    prompt digests against summaries heartbeated from remote workers, and
+    ``hash()`` is salted per process."""
+    out: List[str] = []
+    prev = b""
+    n = len(tokens) // block_size
+    if max_chunks is not None:
+        n = min(n, max_chunks)
+    for i in range(n):
+        chunk = tokens[i * block_size:(i + 1) * block_size]
+        h = hashlib.blake2b(prev, digest_size=8)
+        h.update(struct.pack(f"<{block_size}I", *chunk))
+        prev = h.digest()
+        out.append(prev.hex())
+    return out
 
 
 @dataclasses.dataclass(eq=False)
@@ -134,6 +157,48 @@ class PrefixCache:
             self.allocator.incref(cow_src)
         return PrefixMatch(blocks=blocks, tokens=matched, cow_src=cow_src,
                            cow_tokens=cow_tokens)
+
+    def walk_full_blocks(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Full-block tree walk for KV handoff export: returns (blocks,
+        matched_tokens) with one caller reference taken per block (released
+        through ``allocator.free`` when the export is done).  Unlike
+        :meth:`match` it moves no hit/lookup counters and never returns a
+        copy-on-write source — the unit of transfer is whole radix-subtree
+        blocks."""
+        bs = self.block_size
+        node = self._root
+        blocks: List[int] = []
+        matched = 0
+        while matched + bs <= len(tokens):
+            child = node.children.get(tuple(tokens[matched:matched + bs]))
+            if child is None:
+                break
+            node = child
+            blocks.append(node.block)
+            matched += bs
+        for b in blocks:
+            self.allocator.incref(b)
+        return blocks, matched
+
+    def summary(self, max_digests: int = 1024) -> Dict[str, Any]:
+        """Routing summary: the cumulative digests of every cached chunk
+        path (see :func:`prefix_digests`).  Small enough to ride the worker
+        heartbeat; the balancer counts how many leading blocks of a prompt
+        a replica already holds by digest-set intersection, without ever
+        shipping token ids over the wire."""
+        digests: List[str] = []
+        stack: List[Tuple[_Node, bytes]] = [(self._root, b"")]
+        while stack and len(digests) < max_digests:
+            node, prev = stack.pop()
+            for chunk, child in node.children.items():
+                h = hashlib.blake2b(prev, digest_size=8)
+                h.update(struct.pack(f"<{len(chunk)}I", *chunk))
+                d = h.digest()
+                digests.append(d.hex())
+                if len(digests) >= max_digests:
+                    break
+                stack.append((child, d))
+        return {"block_size": self.block_size, "digests": digests}
 
     # -- insertion -----------------------------------------------------
 

@@ -108,6 +108,12 @@ class SequenceDescriptor:
     generated: int = 0
     done: bool = False
     in_decode: bool = False  # finished prefill (steady-state fast path)
+    #: per-request sampling temperature; None inherits the step-level
+    #: scalar (the pre-disaggregation deployment-wide knob)
+    temperature: Optional[float] = None
+    #: per-request sampling seed — rows with the same seed in one batch
+    #: still draw independently (the row index is folded in on device)
+    seed: int = 0
 
     @property
     def cur_len(self) -> int:
@@ -195,6 +201,10 @@ class DecodeStateTable:
         # the scratch block (the block table has no entry for them).
         self.limit = np.zeros(max_seqs, np.int32)
         self.active = np.zeros(max_seqs, bool)
+        # per-row sampling state: temp < 0 means "inherit the step-level
+        # scalar temperature" (requests that never set one)
+        self.temp = np.full(max_seqs, -1.0, np.float32)
+        self.seed = np.zeros(max_seqs, np.int32)
         self.hist = np.zeros((max_seqs, max_ctx), np.int32)
         self.hist_len = np.zeros(max_seqs, np.int32)
         self.row_of: Dict[int, int] = {}
@@ -211,6 +221,8 @@ class DecodeStateTable:
         bt[:len(seq.blocks)] = seq.blocks
         self.budget[row] = seq.max_new_tokens
         self.limit[row] = seq.cur_len + seq.max_new_tokens
+        self.temp[row] = -1.0 if seq.temperature is None else seq.temperature
+        self.seed[row] = np.int32(np.uint32(seq.seed & 0xFFFFFFFF))
         self.hist_len[row] = 0
         self.sync(seq)
         return row
@@ -243,6 +255,8 @@ class DecodeStateTable:
         self.next_tok[row] = 0
         self.gen[row] = 0
         self.limit[row] = 0
+        self.temp[row] = -1.0
+        self.seed[row] = 0
         self.hist_len[row] = 0
         self._free.append(row)
 

@@ -145,61 +145,70 @@ def verify_body(params, caches, tokens, ctx, block_tables, pos_limit,
     return logits.astype(jnp.float32), x, {"k": new_k, "v": new_v}
 
 
-def _accept_and_emit(logits, draft, draft_probs, rng, temperature):
-    """The accept/correct core shared by both propose paths.
+def _accept_and_emit(logits, draft, draft_probs, rng, temps, seeds):
+    """The accept/correct core shared by both propose paths — per row.
 
     logits (S, k+1, V) f32 — target logits at positions ctx..ctx+k;
     draft (S, k) int32 — proposed tokens for positions ctx+1..ctx+k;
     draft_probs (S, k, V) f32 — the proposal distributions the drafts were
-    actually sampled from (ignored in greedy mode).
+    actually sampled from (ignored for greedy rows);
+    temps/seeds (S,) — per-row temperature and request seed.
 
-    Greedy (temperature == 0): accept the longest prefix where the draft
+    Greedy rows (``temps <= 0``): accept the longest prefix where the draft
     matches the target argmax; the token after it is the target's own
     argmax — output is token-identical to non-speculative greedy decode.
 
-    Sampled: accept ``d_i`` with prob ``min(1, p_i(d_i)/q_i(d_i))``; on the
-    first rejection sample the correction from ``norm(max(p_i - q_i, 0))``;
-    if all accepted, sample the bonus from ``p_k`` — exactly the target
-    distribution, per the speculative-sampling identity.
+    Sampled rows: accept ``d_i`` with prob ``min(1, p_i(d_i)/q_i(d_i))``;
+    on the first rejection sample the correction from
+    ``norm(max(p_i - q_i, 0))``; if all accepted, sample the bonus from
+    ``p_k`` — exactly the target distribution, per the
+    speculative-sampling identity.  Both lanes are computed and selected
+    per row with ``jnp.where`` (no scalar ``cond`` — one batch can mix
+    greedy and sampled rows with zero host syncs).
 
     Returns (emitted (S, k+1) int32, accept_len (S,) int32) where
     ``emitted[:, :a+1]`` = accepted drafts + 1 correction/bonus token.
     """
+    from .engine import _row_keys
+
     S, Qk, _ = logits.shape
     k = Qk - 1
 
-    def greedy(_):
-        g = logits.argmax(-1).astype(jnp.int32)  # (S, k+1)
-        a = _leading_accepts(draft == g[:, :k]) if k else \
-            jnp.zeros((S,), jnp.int32)
-        return a.astype(jnp.int32), _take_rows(g, a)
+    # greedy lane — untouched math, so greedy rows stay bit-identical
+    g = logits.argmax(-1).astype(jnp.int32)  # (S, k+1)
+    a_g = _leading_accepts(draft == g[:, :k]) if k else \
+        jnp.zeros((S,), jnp.int32)
+    fin_g = _take_rows(g, a_g)
 
-    def sampled(op_rng):
-        u_rng, fix_rng = jax.random.split(op_rng)
-        p = jax.nn.softmax(logits / jnp.maximum(temperature, 1e-6), axis=-1)
-        if k:
-            q = draft_probs
-            p_d = jnp.take_along_axis(p[:, :k], draft[..., None], -1)[..., 0]
-            q_d = jnp.take_along_axis(q, draft[..., None], -1)[..., 0]
-            u = jax.random.uniform(u_rng, (S, k))
-            a = _leading_accepts(u * q_d < p_d)
-            # correction dist at every position, then select position a:
-            # i < k → norm(max(p_i − q_i, 0)) (fallback p_i if zero mass);
-            # i = k → p_k (bonus)
-            res = jnp.maximum(p[:, :k] - q, 0.0)
-            mass = res.sum(-1, keepdims=True)
-            res = jnp.where(mass > 0, res / jnp.maximum(mass, 1e-20),
-                            p[:, :k])
-            res = jnp.concatenate([res, p[:, k:]], axis=1)  # (S, k+1, V)
-        else:
-            a = jnp.zeros((S,), jnp.int32)
-            res = p
-        fix = jax.random.categorical(
-            fix_rng, jnp.log(_take_rows(res, a) + 1e-20)).astype(jnp.int32)
-        return a.astype(jnp.int32), fix
+    # sampled lane — per-row keys (fold_in of request seed + row index)
+    u_rng, fix_rng = jax.random.split(rng)
+    p = jax.nn.softmax(logits / jnp.maximum(temps, 1e-6)[:, None, None],
+                       axis=-1)
+    if k:
+        q = draft_probs
+        p_d = jnp.take_along_axis(p[:, :k], draft[..., None], -1)[..., 0]
+        q_d = jnp.take_along_axis(q, draft[..., None], -1)[..., 0]
+        u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(
+            _row_keys(u_rng, seeds))
+        a_s = _leading_accepts(u * q_d < p_d)
+        # correction dist at every position, then select position a:
+        # i < k → norm(max(p_i − q_i, 0)) (fallback p_i if zero mass);
+        # i = k → p_k (bonus)
+        res = jnp.maximum(p[:, :k] - q, 0.0)
+        mass = res.sum(-1, keepdims=True)
+        res = jnp.where(mass > 0, res / jnp.maximum(mass, 1e-20),
+                        p[:, :k])
+        res = jnp.concatenate([res, p[:, k:]], axis=1)  # (S, k+1, V)
+    else:
+        a_s = jnp.zeros((S,), jnp.int32)
+        res = p
+    fix = jax.vmap(jax.random.categorical)(
+        _row_keys(fix_rng, seeds),
+        jnp.log(_take_rows(res, a_s) + 1e-20)).astype(jnp.int32)
 
-    a, final = jax.lax.cond(temperature > 0.0, sampled,
-                            lambda _: greedy(None), rng)
+    sampled_row = temps > 0.0
+    a = jnp.where(sampled_row, a_s, a_g).astype(jnp.int32)
+    final = jnp.where(sampled_row, fix, fin_g)
     cols = jnp.arange(k + 1)[None, :]
     d_pad = jnp.concatenate([draft, jnp.zeros((S, 1), jnp.int32)], axis=1)
     emitted = jnp.where(cols < a[:, None], d_pad, final[:, None])
@@ -219,22 +228,22 @@ def build_self_draft_step(model_cfg: tfm.TransformerConfig, v2):
     from ...linear.spec_heads import apply_spec_heads
 
     def spec_step(params, heads, caches, next_tok, ctx, block_tables,
-                  pos_limit, last_hidden, rng, temperature):
-        S = next_tok.shape[0]
+                  pos_limit, last_hidden, rng, temps, seeds):
+        from .engine import _row_keys
+
         head_logits = apply_spec_heads(heads, last_hidden)  # (S, k, V) f32
         d_rng, v_rng = jax.random.split(rng)
-        q = jax.nn.softmax(head_logits / jnp.maximum(temperature, 1e-6), -1)
-        draft = jax.lax.cond(
-            temperature > 0.0,
-            lambda r: jax.random.categorical(r, jnp.log(q + 1e-20), axis=-1
-                                             ).astype(jnp.int32),
-            lambda r: head_logits.argmax(-1).astype(jnp.int32),
-            d_rng)
+        q = jax.nn.softmax(
+            head_logits / jnp.maximum(temps, 1e-6)[:, None, None], -1)
+        cat = jax.vmap(lambda kk, lg: jax.random.categorical(kk, lg, axis=-1))(
+            _row_keys(d_rng, seeds), jnp.log(q + 1e-20)).astype(jnp.int32)
+        draft = jnp.where((temps > 0.0)[:, None], cat,
+                          head_logits.argmax(-1).astype(jnp.int32))
         tokens = jnp.concatenate([next_tok[:, None], draft], axis=1)
         logits, hidden, caches = verify_body(
             params, caches, tokens, ctx, block_tables, pos_limit,
             model_cfg, v2)
-        emitted, a = _accept_and_emit(logits, draft, q, v_rng, temperature)
+        emitted, a = _accept_and_emit(logits, draft, q, v_rng, temps, seeds)
         new_hidden = _take_rows(hidden, a).astype(jnp.float32)  # (S, H)
         return emitted, a, new_hidden, caches
 
@@ -262,9 +271,11 @@ def build_draft_spec_step(model_cfg: tfm.TransformerConfig,
     k = v2.spec_k
 
     def spec_step(params, draft_params, caches, draft_caches, next_tok, ctx,
-                  block_tables, pos_limit, rng, temperature):
-        S = next_tok.shape[0]
+                  block_tables, pos_limit, rng, temps, seeds):
+        from .engine import _row_keys
+
         active = ctx > 0
+        sampled_row = temps > 0.0
 
         def draft_iter(carry, i):
             dcaches, tok, it_rng = carry
@@ -275,13 +286,12 @@ def build_draft_spec_step(model_cfg: tfm.TransformerConfig,
                 (pos + 1) * ok, draft_cfg, v2)
             it_rng, s_rng = jax.random.split(it_rng)
             qi = jax.nn.softmax(
-                dlogits / jnp.maximum(temperature, 1e-6), axis=-1)
-            nxt = jax.lax.cond(
-                temperature > 0.0,
-                lambda r: jax.random.categorical(
-                    r, jnp.log(qi + 1e-20), axis=-1).astype(jnp.int32),
-                lambda r: dlogits.argmax(-1).astype(jnp.int32),
-                s_rng)
+                dlogits / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+            cat = jax.vmap(jax.random.categorical)(
+                _row_keys(s_rng, seeds),
+                jnp.log(qi + 1e-20)).astype(jnp.int32)
+            nxt = jnp.where(sampled_row, cat,
+                            dlogits.argmax(-1).astype(jnp.int32))
             return (dcaches, nxt, it_rng), (nxt, qi)
 
         d_rng, v_rng = jax.random.split(rng)
@@ -293,7 +303,7 @@ def build_draft_spec_step(model_cfg: tfm.TransformerConfig,
         logits, _, caches = verify_body(
             params, caches, tokens, ctx, block_tables, pos_limit,
             model_cfg, v2)
-        emitted, a = _accept_and_emit(logits, draft, q, v_rng, temperature)
+        emitted, a = _accept_and_emit(logits, draft, q, v_rng, temps, seeds)
         return emitted, a, caches, draft_caches
 
     from .engine import _memo

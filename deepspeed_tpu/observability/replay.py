@@ -77,6 +77,7 @@ WORKLOAD_VERSION = 1
 _RECORD_KEYS = {
     "offset_s", "prompt", "max_new_tokens", "stop_token_ids",
     "deadline_s", "cancel_after_s", "rid", "template",
+    "temperature", "tenant", "slo_class",
 }
 
 
@@ -99,6 +100,9 @@ class WorkloadRequest:
     cancel_after_s: Optional[float] = None
     rid: Optional[str] = None
     template: Optional[int] = None
+    temperature: Optional[float] = None
+    tenant: Optional[str] = None
+    slo_class: Optional[str] = None
 
     def to_record(self) -> Dict[str, Any]:
         rec: Dict[str, Any] = {"offset_s": round(self.offset_s, 6),
@@ -115,6 +119,12 @@ class WorkloadRequest:
             rec["rid"] = self.rid
         if self.template is not None:
             rec["template"] = int(self.template)
+        if self.temperature is not None:
+            rec["temperature"] = float(self.temperature)
+        if self.tenant is not None:
+            rec["tenant"] = self.tenant
+        if self.slo_class is not None:
+            rec["slo_class"] = self.slo_class
         return rec
 
     @classmethod
@@ -140,7 +150,9 @@ class WorkloadRequest:
             stop_token_ids=tuple(rec.get("stop_token_ids", ())),
             deadline_s=rec.get("deadline_s"),
             cancel_after_s=rec.get("cancel_after_s"),
-            rid=rec.get("rid"), template=rec.get("template"))
+            rid=rec.get("rid"), template=rec.get("template"),
+            temperature=rec.get("temperature"), tenant=rec.get("tenant"),
+            slo_class=rec.get("slo_class"))
 
 
 # ---------------------------------------------------------------------------
@@ -223,7 +235,10 @@ class WorkloadCapture:
     def _note_submit(self, rid: str, t: float, prompt: Sequence[int],
                      max_new_tokens: Optional[int],
                      stop_token_ids: Sequence[int],
-                     deadline_s: Optional[float]) -> None:
+                     deadline_s: Optional[float],
+                     temperature: Optional[float] = None,
+                     tenant: Optional[str] = None,
+                     slo_class: Optional[str] = None) -> None:
         with self._lock:
             if rid in self._by_rid:
                 return  # failover resubmit of a captured request
@@ -235,6 +250,8 @@ class WorkloadCapture:
                 "max_new_tokens": max_new_tokens,
                 "stop_token_ids": tuple(int(x) for x in stop_token_ids),
                 "deadline_s": deadline_s, "cancel_after_s": None,
+                "temperature": temperature, "tenant": tenant,
+                "slo_class": slo_class,
             }
             self._order.append(rid)
 
@@ -257,7 +274,9 @@ class WorkloadCapture:
                 max_new_tokens=rec["max_new_tokens"],
                 stop_token_ids=rec["stop_token_ids"],
                 deadline_s=rec["deadline_s"],
-                cancel_after_s=rec["cancel_after_s"], rid=rid)
+                cancel_after_s=rec["cancel_after_s"], rid=rid,
+                temperature=rec["temperature"], tenant=rec["tenant"],
+                slo_class=rec["slo_class"])
                 for rid in self._order
                 for rec in (self._by_rid[rid],)]
 
@@ -286,14 +305,19 @@ class WorkloadCapture:
 def note_submit(rid: str, t: float, prompt: Sequence[int],
                 max_new_tokens: Optional[int],
                 stop_token_ids: Sequence[int],
-                deadline_s: Optional[float]) -> None:
+                deadline_s: Optional[float],
+                temperature: Optional[float] = None,
+                tenant: Optional[str] = None,
+                slo_class: Optional[str] = None) -> None:
     """Broker hook: record a submit into the installed capture (no-op —
     one dict lookup — when no capture is running)."""
     cap = _capture
     if cap is not None:
         try:
             cap._note_submit(rid, t, prompt, max_new_tokens,
-                             stop_token_ids, deadline_s)
+                             stop_token_ids, deadline_s,
+                             temperature=temperature, tenant=tenant,
+                             slo_class=slo_class)
         except Exception:  # noqa: BLE001 — must never break the submit path
             pass
 
@@ -321,7 +345,10 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
                         vocab: int = 250,
                         max_new_tokens: int = 8,
                         cancel_fraction: float = 0.0,
-                        deadline_s: Optional[float] = None
+                        deadline_s: Optional[float] = None,
+                        tenants: int = 0,
+                        sampled_fraction: float = 0.0,
+                        sampled_temperature: float = 0.7
                         ) -> Tuple[Dict[str, Any], List[WorkloadRequest]]:
     """Seeded synthetic workload with production-shaped structure:
 
@@ -332,7 +359,12 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
       prefix (picked with probability ∝ 1/rank^a) plus a unique suffix,
       so prefix-cache hit structure is part of the workload;
     * **geometric generation budgets** capped at ``max_new_tokens``;
-    * optional **cancels** on a seeded fraction of requests.
+    * optional **cancels** on a seeded fraction of requests;
+    * optional **tenants** — requests carry a uniform ``tenant{i}`` label
+      (per-tenant goodput accounting needs labeled traffic);
+    * optional **per-request sampling** — a seeded ``sampled_fraction``
+      of requests carries ``sampled_temperature`` while the rest stays
+      greedy, so one batch mixes both lanes of the per-row sampler.
 
     Deterministic: same arguments → identical workload.
     """
@@ -357,6 +389,8 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
         1 + rng.geometric(min(1.0, 2.0 / max(2, max_new_tokens)),
                           size=num_requests))
     cancel_mask = rng.random(num_requests) < cancel_fraction
+    tenant_picks = rng.integers(0, max(1, tenants), size=num_requests)
+    sampled_mask = rng.random(num_requests) < sampled_fraction
     requests: List[WorkloadRequest] = []
     for i in range(num_requests):
         tpl = int(picks[i])
@@ -369,14 +403,18 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
             deadline_s=deadline_s,
             cancel_after_s=(float(0.05 + 0.1 * rng.random())
                             if cancel_mask[i] else None),
-            template=tpl))
+            template=tpl,
+            temperature=(float(sampled_temperature)
+                         if sampled_mask[i] else None),
+            tenant=(f"tenant{int(tenant_picks[i])}" if tenants else None)))
     meta = {"source": "synthetic", "seed": seed,
             "requests": num_requests, "mean_rate_rps": mean_rate_rps,
             "gamma_shape": gamma_shape, "num_templates": num_templates,
             "template_len": template_len, "suffix_len": suffix_len,
             "zipf_a": zipf_a, "vocab": vocab,
             "max_new_tokens": max_new_tokens,
-            "cancel_fraction": cancel_fraction}
+            "cancel_fraction": cancel_fraction, "tenants": tenants,
+            "sampled_fraction": sampled_fraction}
     return meta, requests
 
 
@@ -527,7 +565,9 @@ def replay_workload(pool, workload: Sequence[WorkloadRequest],
                 handle = pool.submit(
                     r.prompt, max_new_tokens=r.max_new_tokens,
                     deadline_s=r.deadline_s,
-                    stop_token_ids=r.stop_token_ids)
+                    stop_token_ids=r.stop_token_ids,
+                    temperature=r.temperature,
+                    tenant=r.tenant, slo_class=r.slo_class)
             except Exception as e:  # noqa: BLE001 — QueueFull/NoReplica
                 results[i] = {
                     "index": i, "rid": None,
@@ -605,6 +645,7 @@ def summarize_replay(records: Sequence[Dict[str, Any]],
         "ttft_ms_p99": _ms(_pct(ttfts, 0.99)),
         "tpot_ms_p50": _ms(_pct(tpots, 0.50)),
         "tpot_ms_p95": _ms(_pct(tpots, 0.95)),
+        "tpot_ms_p99": _ms(_pct(tpots, 0.99)),
         "e2e_ms_p50": _ms(_pct(e2es, 0.50)),
         "e2e_ms_p95": _ms(_pct(e2es, 0.95)),
         "queue_depth_p50": _pct(list(qdepth), 0.50),
@@ -643,7 +684,7 @@ class SLOViolation:
 _SLO_KEYS = {
     "description",
     "max_ttft_ms_p50", "max_ttft_ms_p95", "max_ttft_ms_p99",
-    "max_tpot_ms_p50", "max_tpot_ms_p95",
+    "max_tpot_ms_p50", "max_tpot_ms_p95", "max_tpot_ms_p99",
     "max_e2e_ms_p50", "max_e2e_ms_p95",
     "min_goodput_rps", "min_tokens_per_s",
     "min_completed_fraction", "max_failed", "max_rejected",

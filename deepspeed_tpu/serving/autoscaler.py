@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import List, Optional
 
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
@@ -44,10 +44,31 @@ from .config import ServingConfig
 from .metrics import ServingMetrics
 
 
+class _ScaleGroup:
+    """Per-class debounce state.  ``cls`` None means "every replica" —
+    the single group of the pre-disaggregation autoscaler."""
+
+    def __init__(self, cls: Optional[str], lo: int, hi: int):
+        self.cls = cls
+        self.min = lo
+        self.max = hi
+        self.hot_since: Optional[float] = None
+        self.cold_since: Optional[float] = None
+        self.blocked_noted = False
+        self.cooldown_until = 0.0
+
+
 class Autoscaler:
     """Control loop over a remote :class:`~deepspeed_tpu.serving.balancer.
     ReplicaPool`: spawn via ``pool.spawn_remote_replica``, retire via
-    ``pool.retire_replica``."""
+    ``pool.retire_replica``.
+
+    With ``config.autoscale_class_bounds`` set, each listed replica class
+    scales independently off the same pressure signal (class-filtered),
+    within its own (min, max); replicas of unlisted classes share one
+    residual group under the global ``autoscale_min``/``autoscale_max``.
+    An empty table is the pre-disaggregation behaviour: one group, every
+    replica, global bounds."""
 
     def __init__(self, pool, config: ServingConfig,
                  metrics: Optional[ServingMetrics] = None):
@@ -56,12 +77,17 @@ class Autoscaler:
         self.metrics = metrics or pool.metrics
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # debounce state
-        self._hot_since: Optional[float] = None
-        self._cold_since: Optional[float] = None
-        self._blocked_noted = False
-        self._cooldown_until = 0.0
-        # ban discipline
+        # per-class (or single-group) debounce state
+        if config.autoscale_class_bounds:
+            self._groups = [
+                _ScaleGroup(cls, lo, hi) for cls, (lo, hi)
+                in sorted(config.autoscale_class_bounds.items())]
+            self._groups.append(_ScaleGroup(
+                None, config.autoscale_min, config.autoscale_max))
+        else:
+            self._groups = [_ScaleGroup(None, config.autoscale_min,
+                                        config.autoscale_max)]
+        # ban discipline (launcher-level: one ban covers every class)
         self._spawn_fails = 0
         self.banned = False
         #: decision mirror for quick assertions/bench reporting
@@ -95,62 +121,103 @@ class Autoscaler:
 
     # -- control law -----------------------------------------------------
 
-    def pressure(self) -> float:
-        n = len(self.pool.healthy_replicas())
-        backlog = self.pool.queue_depth() + sum(
-            t.outstanding_tokens() for t in self.pool.replicas)
+    def _members(self, g: _ScaleGroup) -> List[int]:
+        """Healthy replica indices this group governs."""
+        healthy = self.pool.healthy_replicas()
+        if g.cls is None:
+            if len(self._groups) == 1:
+                return healthy  # single group: everyone
+            # residual group: classes without their own bounds entry
+            bounded = set(self.cfg.autoscale_class_bounds)
+            return [i for i in healthy
+                    if self.pool.replicas[i].replica_class not in bounded]
+        return [i for i in healthy
+                if self.pool.replicas[i].replica_class == g.cls]
+
+    def pressure(self, replica_class: Optional[str] = None) -> float:
+        """Per-replica backlog (queued requests + outstanding generation
+        tokens), optionally filtered to one replica class — the SAME
+        signal, narrowed to the replicas that can absorb it."""
+        if replica_class is None:
+            reps = list(self.pool.replicas)
+        else:
+            reps = [self.pool.replicas[i]
+                    for i in self.pool.replicas_of_class(replica_class)]
+        n = sum(1 for t in reps if t.healthy())
+        backlog = sum(t.queue_depth() + t.outstanding_tokens()
+                      for t in reps)
         return backlog / max(1, n)
+
+    def _group_pressure(self, members: List[int]) -> float:
+        backlog = sum(self.pool.replicas[i].queue_depth() +
+                      self.pool.replicas[i].outstanding_tokens()
+                      for i in members)
+        return backlog / max(1, len(members))
 
     def _tick(self) -> None:
         now = time.monotonic()
-        n = len(self.pool.healthy_replicas())
-        p = self.pressure()
+        for g in self._groups:
+            self._tick_group(g, now)
 
-        if n < self.cfg.autoscale_min:
+    def _tick_group(self, g: _ScaleGroup, now: float) -> None:
+        members = self._members(g)
+        n = len(members)
+        p = self._group_pressure(members)
+
+        if g.cls is None and len(self._groups) > 1 and n == 0:
+            # residual group with nothing deployed: a class-bounded fleet
+            # that never launched a mixed replica must not have one
+            # invented by the global floor
+            return
+
+        if n < g.min:
             # availability floor: restore immediately (no debounce)
-            self._scale_up(now, n, p, reason="below_min")
+            self._scale_up(g, now, n, p, reason="below_min")
             return
 
         if p > self.cfg.scale_up_pressure:
-            self._cold_since = None
-            if self._hot_since is None:
-                self._hot_since = now
-            if now - self._hot_since < self.cfg.scale_up_debounce_s:
+            g.cold_since = None
+            if g.hot_since is None:
+                g.hot_since = now
+            if now - g.hot_since < self.cfg.scale_up_debounce_s:
                 return
-            if self.cfg.autoscale_max and n >= self.cfg.autoscale_max:
-                if not self._blocked_noted:
-                    self._blocked_noted = True
-                    self._record("blocked", n=n, pressure=p)
+            if g.max and n >= g.max:
+                if not g.blocked_noted:
+                    g.blocked_noted = True
+                    self._record("blocked", n=n, pressure=p,
+                                 replica_class=g.cls or "all")
                 return
-            if self.banned or now < self._cooldown_until:
+            if self.banned or now < g.cooldown_until:
                 return
-            self._scale_up(now, n, p, reason="pressure")
+            self._scale_up(g, now, n, p, reason="pressure")
             return
 
-        self._hot_since = None
-        self._blocked_noted = False
+        g.hot_since = None
+        g.blocked_noted = False
 
-        if p < self.cfg.scale_down_pressure and n > self.cfg.autoscale_min:
-            if self._cold_since is None:
-                self._cold_since = now
-            if now - self._cold_since < self.cfg.scale_down_idle_s:
+        if p < self.cfg.scale_down_pressure and n > g.min:
+            if g.cold_since is None:
+                g.cold_since = now
+            if now - g.cold_since < self.cfg.scale_down_idle_s:
                 return
-            self._cold_since = None
-            self._scale_down(n, p)
+            g.cold_since = None
+            self._scale_down(g, members, n, p)
         else:
-            self._cold_since = None
+            g.cold_since = None
 
-    def _scale_up(self, now: float, n: int, p: float, reason: str) -> None:
+    def _scale_up(self, g: _ScaleGroup, now: float, n: int, p: float,
+                  reason: str) -> None:
         if self.banned:
             return
         try:
-            name = self.pool.spawn_remote_replica()
+            name = self.pool.spawn_remote_replica(
+                replica_class=g.cls or "mixed")
         except Exception as e:  # noqa: BLE001 — spawn failure is a strike
             self._spawn_fails += 1
             backoff = exponential_backoff(self.cfg.autoscale_backoff_s,
                                           self.cfg.autoscale_backoff_max_s,
                                           self._spawn_fails)
-            self._cooldown_until = now + backoff
+            g.cooldown_until = now + backoff
             logger.warning(f"autoscaler: spawn failed ({e!r}), strike "
                            f"{self._spawn_fails}, backoff {backoff:.1f}s")
             if self._spawn_fails >= self.cfg.autoscale_max_spawn_fails:
@@ -158,24 +225,27 @@ class Autoscaler:
                 logger.error("autoscaler: BANNED from scaling up after "
                              f"{self._spawn_fails} consecutive spawn "
                              "failures")
-                self._record("blocked", n=n, pressure=p, banned=True)
+                self._record("blocked", n=n, pressure=p, banned=True,
+                             replica_class=g.cls or "all")
             return
         self._spawn_fails = 0
-        self._hot_since = None
-        self._cooldown_until = now + self.cfg.scale_up_debounce_s
-        self._record("up", n=n, pressure=p, replica=name, reason=reason)
+        g.hot_since = None
+        g.cooldown_until = now + self.cfg.scale_up_debounce_s
+        self._record("up", n=n, pressure=p, replica=name, reason=reason,
+                     replica_class=g.cls or "all")
 
-    def _scale_down(self, n: int, p: float) -> None:
+    def _scale_down(self, g: _ScaleGroup, members: List[int], n: int,
+                    p: float) -> None:
         # retire the newest (highest-index) routable replica so the
         # stable core of the fleet keeps its warm engines
-        victims = [self.pool.replicas[i].name
-                   for i in self.pool.healthy_replicas()
+        victims = [self.pool.replicas[i].name for i in members
                    if self.pool.replicas[i].name not in self.pool._quiesced]
-        if len(victims) <= self.cfg.autoscale_min:
+        if len(victims) <= g.min:
             return
         victim = victims[-1]
         if self.pool.retire_replica(victim, self.cfg.drain_timeout_s):
-            self._record("down", n=n, pressure=p, replica=victim)
+            self._record("down", n=n, pressure=p, replica=victim,
+                         replica_class=g.cls or "all")
 
     def _record(self, decision: str, **attrs) -> None:
         self.decisions[decision] += 1

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
+from ..inference.v2.prefix_cache import prefix_digests
 from ..monitor.monitor import Monitor
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
@@ -49,6 +50,14 @@ class NoReplicaError(RuntimeError):
 
 
 _RETRYABLE = ("replica_dead", "engine_error", "shutdown")
+
+
+def _slot_class(config: ServingConfig, i: int) -> str:
+    """Per-slot replica class from ``config.replica_classes`` (index-
+    aligned with the slot number; slots past the tuple are "mixed")."""
+    if i < len(config.replica_classes):
+        return config.replica_classes[i]
+    return "mixed"
 
 
 class BalancedHandle:
@@ -171,6 +180,16 @@ class ReplicaPool:
         #: monotonically-increasing suffix for autoscaler-minted slot
         #: names; never reused so traces/metrics stay unambiguous
         self._slot_seq = len(self.replicas)
+        # per-slot phase classes (Splitwise/DistServe disaggregation):
+        # pool-side assignment; a dial-in worker's declared class wins
+        for i, t in enumerate(self.replicas):
+            cls = _slot_class(config, i)
+            if cls != "mixed":
+                t.replica_class = cls
+        #: routing-decision ledger: requests routed per phase, plus how
+        #: often cache-aware routing found a replica with a warm prefix
+        self.route_stats: Dict[str, int] = {
+            "prefill": 0, "decode": 0, "cache_hits": 0}
         self.supervisor = None
         if any(isinstance(t, FramedReplica) for t in self.replicas):
             from .supervisor import ReplicaSupervisor
@@ -207,10 +226,12 @@ class ReplicaPool:
         ``extra_env`` is merged into every worker's environment on each
         (re)spawn — chaos tests arm persistent ``DSTPU_FAULTS`` there."""
         metrics = metrics or ServingMetrics()
-        transports = [SubprocessReplica(worker_argv, config,
-                                        name=f"replica{i}", metrics=metrics,
-                                        extra_env=extra_env)
-                      for i in range(config.num_replicas)]
+        # per-slot --replica_class rides the worker argv (appended last,
+        # so it wins over any class already present in worker_argv)
+        transports = [SubprocessReplica(
+            list(worker_argv) + ["--replica_class", _slot_class(config, i)],
+            config, name=f"replica{i}", metrics=metrics, extra_env=extra_env)
+            for i in range(config.num_replicas)]
         return cls(transports, config, metrics=metrics, monitor=monitor)
 
     @classmethod
@@ -232,7 +253,8 @@ class ReplicaPool:
         registry = WorkerRegistry(config, metrics)
         launcher = (LocalWorkerLauncher(worker_argv, config, extra_env)
                     if launch_workers else None)
-        slots = [RemoteReplica(config, f"replica{i}", metrics, launcher)
+        slots = [RemoteReplica(config, f"replica{i}", metrics, launcher,
+                               replica_class=_slot_class(config, i))
                  for i in range(config.num_replicas)]
         for s in slots:
             registry.register_slot(s)
@@ -385,8 +407,11 @@ class ReplicaPool:
         self.wait_drained(name, drain_timeout_s)
         return self.remove_replica(name)
 
-    def spawn_remote_replica(self, name: Optional[str] = None) -> str:
-        """Mint, register, and start a fresh remote slot (scale-up)."""
+    def spawn_remote_replica(self, name: Optional[str] = None,
+                             replica_class: str = "mixed") -> str:
+        """Mint, register, and start a fresh remote slot (scale-up);
+        ``replica_class`` rides the launcher argv so the worker dials in
+        already wearing its phase class."""
         if self.registry is None:
             raise RuntimeError("spawn_remote_replica needs a remote pool")
         from .remote import RemoteReplica
@@ -394,7 +419,8 @@ class ReplicaPool:
             if name is None:
                 name = f"replica{self._slot_seq}"
             self._slot_seq += 1
-        slot = RemoteReplica(self.cfg, name, self.metrics, self._launcher)
+        slot = RemoteReplica(self.cfg, name, self.metrics, self._launcher,
+                             replica_class=replica_class)
         self.registry.register_slot(slot)
         try:
             self.add_replica(slot)
@@ -402,6 +428,44 @@ class ReplicaPool:
             self.registry.unregister_slot(name)
             raise
         return name
+
+    def replicas_of_class(self, replica_class: str) -> List[int]:
+        """Indices of replicas wearing ``replica_class`` (autoscaler's
+        per-class census; "mixed" replicas count only as "mixed")."""
+        return [i for i, t in enumerate(self.replicas)
+                if t.replica_class == replica_class]
+
+    def handoff_prefix(self, src_name: str, dst_name: str,
+                       tokens: Sequence[int]) -> int:
+        """Move the cached KV blocks covering ``tokens`` from one
+        replica's radix tree to another's — the prefix-subtree unit of
+        transfer for prefill→decode handoff.  Serialized through the
+        blocked-KV safetensors payload (``engine.export_prefix`` /
+        ``import_prefix``), so the bytes are exactly what the io layer
+        would put on disk.  Both replicas must expose an engine
+        (in-process transports) and should be idle or quiesced — the
+        engine is single-threaded by its broker.  Returns tokens now
+        cached on the destination (0 when nothing was cached)."""
+        src, dst = self._by_name(src_name), self._by_name(dst_name)
+        if src is None or dst is None:
+            raise ValueError(f"unknown replica {src_name!r} or {dst_name!r}")
+        src_eng = getattr(src, "engine", None)
+        dst_eng = getattr(dst, "engine", None)
+        if src_eng is None or dst_eng is None:
+            raise RuntimeError(
+                "prefix handoff needs engine access (in-process replicas); "
+                "remote workers exchange prefixes via their own hand-off op")
+        payload = src_eng.export_prefix(list(tokens))
+        if payload is None:
+            return 0
+        covered = dst_eng.import_prefix(payload)
+        tracer.add_event("replica/prefix_handoff",
+                         attrs={"src": src_name, "dst": dst_name,
+                                "tokens": covered,
+                                "payload_bytes": len(payload)})
+        recorder.record_event("replica/prefix_handoff", src=src_name,
+                              dst=dst_name, tokens=covered)
+        return covered
 
     def drain(self, timeout: Optional[float] = None) -> None:
         """Graceful shutdown: stop accepting, let outstanding requests
@@ -454,15 +518,70 @@ class ReplicaPool:
 
     # -- routing ---------------------------------------------------------
 
-    def _pick(self, exclude: Sequence[int] = ()) -> int:
+    def _request_phase(self, prompt_len: int,
+                       max_new_tokens: Optional[int]) -> str:
+        """Classify a REQUEST by its dominant phase: prompt-heavy work
+        belongs on "prefill"-class replicas, generation-heavy on "decode".
+        The request runs to completion wherever it lands — the class is a
+        routing preference, not a migration."""
+        mnt = max_new_tokens if max_new_tokens else self.cfg.default_max_tokens
+        if prompt_len >= self.cfg.phase_prefill_ratio * max(1, mnt):
+            return "prefill"
+        return "decode"
+
+    def _digest_overlap(self, i: int, prompt: Sequence[int]) -> int:
+        """Leading radix-tree blocks of ``prompt`` that replica ``i``
+        already holds, by digest comparison against its heartbeated
+        summary (never raises; an unreachable replica scores 0)."""
+        try:
+            s = self.replicas[i].prefix_summary()
+        except Exception:  # noqa: BLE001 — routing must not die with a replica
+            return 0
+        digs = s.get("digests")
+        bs = int(s.get("block_size", 0) or 0)
+        if not digs or bs <= 0:
+            return 0
+        have = frozenset(digs)
+        n = 0
+        for d in prefix_digests(prompt, bs, max_chunks=64):
+            if d not in have:
+                break
+            n += 1
+        return n
+
+    def _pick(self, exclude: Sequence[int] = (),
+              phase: Optional[str] = None,
+              prompt: Optional[Sequence[int]] = None) -> int:
         healthy = [i for i in self.healthy_replicas()
                    if i not in exclude
                    and self.replicas[i].name not in self._quiesced]
         if not healthy:
             raise NoReplicaError("no healthy replica")
+        cache_hit = False
+        if phase is not None:
+            # prefer the exact class, then "mixed"; an all-wrong-class
+            # pool still serves (degraded placement beats a 503)
+            exact = [i for i in healthy
+                     if self.replicas[i].replica_class == phase]
+            compat = exact or [i for i in healthy
+                               if self.replicas[i].replica_class == "mixed"]
+            healthy = compat or healthy
+        if prompt is not None and self.cfg.cache_aware_routing \
+                and len(healthy) > 1:
+            # cache-aware: the replica whose radix tree already holds the
+            # longest leading prefix wins outright; load only tiebreaks
+            scores = {i: self._digest_overlap(i, prompt) for i in healthy}
+            best = max(scores.values())
+            if best > 0:
+                healthy = [i for i in healthy if scores[i] == best]
+                cache_hit = True
         with self._lock:
             self._rr += 1
             rr = self._rr
+            if phase is not None:
+                self.route_stats[phase] = self.route_stats.get(phase, 0) + 1
+            if cache_hit:
+                self.route_stats["cache_hits"] += 1
         # least outstanding tokens; stable round-robin among ties
         return min(healthy,
                    key=lambda i: (self.replicas[i].outstanding_tokens(),
@@ -493,9 +612,12 @@ class ReplicaPool:
                     else time.monotonic() + self.cfg.failover_wait_s)
         tried: List[int] = []
         last: Optional[Exception] = None
+        prompt = kwargs.get("prompt") or []
+        phase = self._request_phase(len(prompt),
+                                    kwargs.get("max_new_tokens"))
         while True:
             try:
-                idx = self._pick(exclude=tried)
+                idx = self._pick(exclude=tried, phase=phase, prompt=prompt)
             except NoReplicaError:
                 if isinstance(last, QueueFullError):
                     raise last
@@ -525,6 +647,7 @@ class ReplicaPool:
         try:
             entry = {
                 "index": i, "name": t.name, "healthy": t.healthy(),
+                "replica_class": t.replica_class,
                 "queue_depth": t.queue_depth(),
                 "outstanding_tokens": t.outstanding_tokens(),
                 "running": t.num_running(),
@@ -555,6 +678,7 @@ class ReplicaPool:
                 # live capacity signal for graceful degradation: mean KV
                 # pressure across the replicas actually taking traffic
                 "kv_utilization": round(sum(kv) / len(kv), 4) if kv else 0.0,
+                "route_stats": dict(self.route_stats),
                 "replicas": reps}
 
     def _aggregate_prefix_stats(self) -> Dict[str, float]:

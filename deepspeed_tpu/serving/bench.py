@@ -424,7 +424,10 @@ def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
                slo_workload: Optional[str] = None,
                model: str = "tiny", max_queue: int = 64,
                save_trace: Optional[str] = None,
-               autoscale_min: int = 0, autoscale_max: int = 0) -> dict:
+               autoscale_min: int = 0, autoscale_max: int = 0,
+               replica_classes: Optional[str] = None,
+               tenants: int = 0, template_len: int = 12,
+               max_new_tokens: int = 8, ab_repeats: int = 1) -> dict:
     """Replay a workload trace (recorded JSONL or seeded synthesis) against
     a fresh replica pool — driven at the pool, not over HTTP, so the same
     seed reproduces arrival schedule AND token streams exactly — then gate
@@ -437,13 +440,26 @@ def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
     (the load phase should show >=1 scale-up, the post-drain idle >=1
     scale-down).
 
+    ``replica_classes`` (e.g. ``"prefill,decode"``) runs the SAME workload
+    twice — once phase-disaggregated, once all-mixed at equal replica
+    count — and records the decode TPOT p99 delta (disagg − mixed; the
+    number Splitwise-style splitting is supposed to push ≤ 0, since decode
+    steps no longer queue behind prompt-heavy prefills); ``ab_repeats``
+    repeats the disagg/mixed pair and reports the per-pair median delta
+    (single-run p99s on shared CI machines are noise-dominated).
+    ``tenants`` > 0 labels synthesized traffic ``tenant0..N-1`` and
+    reports the per-tenant goodput ledger; ``template_len`` /
+    ``max_new_tokens`` shape the synthesized prompts and budgets (long
+    templates + bimodal budgets make the prefill/decode phase split
+    non-trivial).
+
     The result carries ``slo_violations`` (named-key diffs); ``main``
     turns a non-empty list into a nonzero exit."""
     import argparse
 
     from ..observability import replay as rp
     from .balancer import ReplicaPool
-    from .config import ServingConfig
+    from .config import ServingConfig, parse_replica_classes
     from .server import (add_engine_cli_args, add_serving_cli_args,
                          build_engine_factory, engine_argv_from_args,
                          serving_argv_from_config)
@@ -454,7 +470,10 @@ def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
     else:
         meta, wl = rp.synthesize_workload(seed=seed, num_requests=requests,
                                           mean_rate_rps=rate_rps,
-                                          cancel_fraction=cancel_fraction)
+                                          cancel_fraction=cancel_fraction,
+                                          tenants=tenants,
+                                          template_len=template_len,
+                                          max_new_tokens=max_new_tokens)
         slo_workload = slo_workload or "synthetic-smoke"
     if save_trace:
         rp.save_workload(save_trace, wl, meta)
@@ -463,6 +482,7 @@ def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
         raise rp.SLOError(f"no [workloads.\"{slo_workload}\"] table in "
                           f"{slo_path or rp.default_slo_path()}; have "
                           f"{sorted(slos)}")
+    slot_classes = parse_replica_classes(replica_classes)
 
     # small fixed engine geometry: big enough for the synthetic prompts
     # (16 tok) + budgets (≤8 tok), small enough to compile fast on CPU
@@ -476,98 +496,154 @@ def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
         "--max_queue", str(max_queue)])
     autoscaling = transport == "remote" and autoscale_max > 0
     start_replicas = max(1, autoscale_min) if autoscaling else replicas
-    cfg = ServingConfig(max_queue=max_queue, num_replicas=start_replicas,
-                        replica_transport=transport,
-                        heartbeat_interval_s=0.2, heartbeat_timeout_s=2.0,
-                        respawn_backoff_s=0.2, submit_timeout_s=120.0,
-                        spawn_timeout_s=300.0,
-                        autoscale_min=max(1, autoscale_min),
-                        autoscale_max=autoscale_max,
-                        # replay load phases last seconds, so the scaling
-                        # thresholds must react inside one phase: low
-                        # pressure bar, sub-second debounce, short idle
-                        autoscale_interval_s=0.25,
-                        scale_up_pressure=6.0, scale_up_debounce_s=0.5,
-                        scale_down_pressure=1.0, scale_down_idle_s=2.0)
-    if transport in ("subprocess", "remote"):
-        worker_argv = (engine_argv_from_args(eargs)
-                       + serving_argv_from_config(cfg))
-        if transport == "remote":
-            pool = ReplicaPool.build_remote(worker_argv, cfg)
+
+    def one_run(classes) -> dict:
+        cfg = ServingConfig(max_queue=max_queue,
+                            num_replicas=start_replicas,
+                            replica_transport=transport,
+                            replica_classes=tuple(classes),
+                            heartbeat_interval_s=0.2,
+                            heartbeat_timeout_s=2.0,
+                            respawn_backoff_s=0.2, submit_timeout_s=120.0,
+                            spawn_timeout_s=300.0,
+                            autoscale_min=max(1, autoscale_min),
+                            autoscale_max=autoscale_max,
+                            # replay load phases last seconds, so the
+                            # scaling thresholds must react inside one
+                            # phase: low pressure bar, sub-second
+                            # debounce, short idle
+                            autoscale_interval_s=0.25,
+                            scale_up_pressure=6.0, scale_up_debounce_s=0.5,
+                            scale_down_pressure=1.0, scale_down_idle_s=2.0)
+        if transport in ("subprocess", "remote"):
+            worker_argv = (engine_argv_from_args(eargs)
+                           + serving_argv_from_config(cfg))
+            if transport == "remote":
+                pool = ReplicaPool.build_remote(worker_argv, cfg)
+            else:
+                pool = ReplicaPool.build_subprocess(worker_argv, cfg)
         else:
-            pool = ReplicaPool.build_subprocess(worker_argv, cfg)
-    else:
-        pool = ReplicaPool.build(build_engine_factory(eargs), cfg)
-    pool.start()
-    pool.wait_ready()
-    autoscaler = None
-    if autoscaling:
-        from .autoscaler import Autoscaler
-        autoscaler = Autoscaler(pool, cfg).start()
-    leaked_blocks = leaked_procs = 0
-    autoscale_report = None
-    try:
-        # warm the compile caches (one concurrent request per replica:
-        # least-outstanding routing spreads them) so the replay's TTFT
-        # percentiles measure serving, not first-touch XLA compiles
-        warm = [pool.submit([1, 2, 3], max_new_tokens=2)
-                for _ in range(len(pool.replicas))]
-        for h in warm:
-            h.result(timeout=300)
-        out = rp.replay_workload(pool, wl, time_scale=time_scale,
-                                 chaos=rp.parse_chaos(chaos))
-        # post-replay leak check while the pool is still up: any pinned KV
-        # blocks left once nothing is running is a leak
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
-            if sum(t.num_running() for t in pool.replicas
-                   if t.healthy()) == 0 and pool.queue_depth() == 0:
-                break
-            time.sleep(0.2)
-        leaked_blocks = int(sum(
-            t.prefix_stats().get("pinned_blocks", 0)
-            for t in pool.replicas if t.healthy()))
-        if autoscaler is not None:
-            # the fleet is idle now; give the autoscaler its idle window
-            # so the post-drain scale-down shows up in the report
-            deadline = time.monotonic() + 30
+            pool = ReplicaPool.build(build_engine_factory(eargs), cfg)
+        pool.start()
+        pool.wait_ready()
+        autoscaler = None
+        if autoscaling:
+            from .autoscaler import Autoscaler
+            autoscaler = Autoscaler(pool, cfg).start()
+        leaked_blocks = leaked_procs = 0
+        autoscale_report = None
+        try:
+            # warm the compile caches (one concurrent request per replica:
+            # least-outstanding routing spreads them) so the replay's TTFT
+            # percentiles measure serving, not first-touch XLA compiles
+            warm = [pool.submit([1, 2, 3], max_new_tokens=2)
+                    for _ in range(len(pool.replicas))]
+            for h in warm:
+                h.result(timeout=300)
+            out = rp.replay_workload(pool, wl, time_scale=time_scale,
+                                     chaos=rp.parse_chaos(chaos))
+            # decode-phase TPOT: filter by the SAME classifier the router
+            # uses, over the SAME workload in both A/B arms.  Aggregate
+            # TPOT mixes in prefill-phase requests, whose inter-token
+            # tail is prefill queueing — the traffic disaggregation
+            # deliberately trades away, not the tail it protects
+            decode_tpots = [
+                t for i, r in enumerate(wl)
+                if pool._request_phase(len(r.prompt),
+                                       r.max_new_tokens) == "decode"
+                for t in out["requests"][i]["tpot_s"]]
+            # post-replay leak check while the pool is still up: any
+            # pinned KV blocks left once nothing is running is a leak
+            deadline = time.monotonic() + 60
             while time.monotonic() < deadline:
-                if autoscaler.decisions["down"] >= 1:
+                if sum(t.num_running() for t in pool.replicas
+                       if t.healthy()) == 0 and pool.queue_depth() == 0:
                     break
-                time.sleep(0.25)
-            autoscale_report = {
-                "min": cfg.autoscale_min, "max": cfg.autoscale_max,
-                "decisions": dict(autoscaler.decisions),
-                "final_replicas": sum(
-                    1 for t in pool.replicas if t.healthy()),
-            }
-    finally:
-        pool.drain()
-    if transport in ("subprocess", "remote"):
-        leaked_procs = sum(
-            1 for t in pool.replicas
-            if getattr(t, "_proc", None) is not None
-            and t._proc.poll() is None)
-    summary = out["summary"]
+                time.sleep(0.2)
+            leaked_blocks = int(sum(
+                t.prefix_stats().get("pinned_blocks", 0)
+                for t in pool.replicas if t.healthy()))
+            if autoscaler is not None:
+                # the fleet is idle now; give the autoscaler its idle
+                # window so the post-drain scale-down shows up
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    if autoscaler.decisions["down"] >= 1:
+                        break
+                    time.sleep(0.25)
+                autoscale_report = {
+                    "min": cfg.autoscale_min, "max": cfg.autoscale_max,
+                    "decisions": dict(autoscaler.decisions),
+                    "final_replicas": sum(
+                        1 for t in pool.replicas if t.healthy()),
+                }
+        finally:
+            pool.drain()
+        if transport in ("subprocess", "remote"):
+            leaked_procs = sum(
+                1 for t in pool.replicas
+                if getattr(t, "_proc", None) is not None
+                and t._proc.poll() is None)
+        return {
+            "summary": out["summary"],
+            "decode_tpot_ms_p99": round(
+                _percentile(decode_tpots, 0.99) * 1e3, 3)
+            if decode_tpots else None,
+            "route_stats": dict(pool.route_stats),
+            "autoscale": autoscale_report,
+            "leaked_blocks": leaked_blocks,
+            "leaked_procs": leaked_procs,
+            "tenant_goodput": pool.metrics.tenant_snapshot(),
+            "outcomes": {
+                r["outcome"]: sum(1 for q in out["requests"]
+                                  if q["outcome"] == r["outcome"])
+                for r in out["requests"]},
+        }
+
+    disagg = one_run(slot_classes)
+    summary = disagg["summary"]
     violations = rp.check_slo(summary, slos[slo_workload], slo_workload)
-    return {
+    result = {
         "subject": f"{model} model, JAX_PLATFORMS=cpu, open-loop replay "
                    f"driven at the ReplicaPool ({transport}, "
-                   f"{replicas} replicas)",
+                   f"{start_replicas} replicas"
+                   + (f", classes {','.join(slot_classes)}"
+                      if slot_classes else "") + ")",
         "workload_meta": meta,
         "time_scale": time_scale,
         "chaos": chaos or None,
         "slo_workload": slo_workload,
         "summary": summary,
-        "autoscale": autoscale_report,
-        "leaked_blocks_after_idle": leaked_blocks,
-        "leaked_worker_processes_after_drain": leaked_procs,
+        "route_stats": disagg["route_stats"],
+        "autoscale": disagg["autoscale"],
+        "leaked_blocks_after_idle": disagg["leaked_blocks"],
+        "leaked_worker_processes_after_drain": disagg["leaked_procs"],
         "slo_violations": [v.to_dict() for v in violations],
-        "outcomes": {
-            r["outcome"]: sum(1 for q in out["requests"]
-                              if q["outcome"] == r["outcome"])
-            for r in out["requests"]},
+        "outcomes": disagg["outcomes"],
     }
+    if tenants:
+        result["tenant_goodput"] = disagg["tenant_goodput"]
+    if slot_classes:
+        # A/B on the identical workload: disagg already ran above; pair it
+        # with an all-mixed run at equal replica count, and (ab_repeats > 1)
+        # repeat the whole pair — a single p99 over a few dozen requests on
+        # a shared CI box is one bad scheduler quantum away from either
+        # sign, the per-pair median is the reportable number
+        pairs = [(disagg, one_run(()))]
+        for _ in range(max(1, ab_repeats) - 1):
+            pairs.append((one_run(slot_classes), one_run(())))
+        deltas = [round(d["decode_tpot_ms_p99"] - m["decode_tpot_ms_p99"], 3)
+                  for d, m in pairs
+                  if d["decode_tpot_ms_p99"] is not None
+                  and m["decode_tpot_ms_p99"] is not None]
+        result["replica_classes"] = list(slot_classes)
+        result["mixed_baseline_summary"] = pairs[0][1]["summary"]
+        result["decode_tpot_ms_p99"] = disagg["decode_tpot_ms_p99"]
+        result["mixed_decode_tpot_ms_p99"] = pairs[0][1]["decode_tpot_ms_p99"]
+        result["disagg_tpot_ms_p99_deltas"] = deltas
+        result["disagg_tpot_ms_p99_delta"] = (
+            sorted(deltas)[len(deltas) // 2] if deltas else None)
+    return result
 
 
 # -- mixed-GEMM kernel microbench ------------------------------------------
@@ -722,6 +798,17 @@ def main(argv=None) -> int:
                         "against")
     p.add_argument("--save_trace", default=None,
                    help="replay: also save the replayed workload as JSONL")
+    p.add_argument("--replica_classes", default=None,
+                   help="replay: per-slot classes (e.g. 'prefill,decode') — "
+                        "runs the workload disaggregated AND all-mixed and "
+                        "records the decode TPOT p99 delta")
+    p.add_argument("--ab_repeats", type=int, default=1,
+                   help="replay --replica_classes: repeat the disagg/mixed "
+                        "pair this many times and report the median delta")
+    p.add_argument("--template_len", type=int, default=12,
+                   help="replay: synthesized prompt-template length")
+    p.add_argument("--max_new_tokens", type=int, default=8,
+                   help="replay: synthesized generation-budget cap")
     args = p.parse_args(argv)
 
     rates = [float(r) for r in args.rates.split(",")]
@@ -735,7 +822,11 @@ def main(argv=None) -> int:
             slo_workload=args.slo_workload,
             max_queue=args.max_queue or 64, save_trace=args.save_trace,
             autoscale_min=args.autoscale_min,
-            autoscale_max=args.autoscale_max)
+            autoscale_max=args.autoscale_max,
+            replica_classes=args.replica_classes, tenants=args.tenants,
+            template_len=args.template_len,
+            max_new_tokens=args.max_new_tokens,
+            ab_repeats=args.ab_repeats)
         key = "replay"
     elif args.mode == "gemm":
         result = run_gemm_sweep(

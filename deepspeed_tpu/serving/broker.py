@@ -29,6 +29,7 @@ import itertools
 import queue
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Deque, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -84,6 +85,17 @@ class _Request:
     stop_ids: frozenset
     deadline: Optional[float]  # absolute monotonic, None = no SLO
     submit_ts: float
+    #: per-request sampling temperature; None inherits the deployment
+    #: scalar (``ServingConfig.temperature``).  Rows mix freely in one
+    #: ragged batch now that sampling is per-row inside the engine step.
+    temperature: Optional[float] = None
+    #: per-request sampling seed (derived from the rid when not given, so
+    #: a failover resubmit reproduces the same stream)
+    seed: int = 0
+    tenant: str = "default"
+    slo_class: str = "standard"
+    #: admission priority from the SLO class table; lower admits first
+    priority: int = 0
     state: RequestState = RequestState.QUEUED
     uid: Optional[int] = None
     delivered: int = 0
@@ -162,6 +174,8 @@ class RequestBroker:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._queue: Deque[_Request] = deque()
+        # tenant -> monotonic ts of its last admission (fairness ordering)
+        self._tenant_last_admit: Dict[str, float] = {}
         self._by_uid: Dict[int, _Request] = {}
         self._by_rid: Dict[str, _Request] = {}
         self._cancels: List[str] = []
@@ -182,7 +196,10 @@ class RequestBroker:
                deadline_s: Optional[float] = None,
                stop_token_ids: Sequence[int] = (),
                rid: Optional[str] = None,
-               trace_id: Optional[str] = None) -> RequestHandle:
+               trace_id: Optional[str] = None,
+               seed: Optional[int] = None,
+               tenant: Optional[str] = None,
+               slo_class: Optional[str] = None) -> RequestHandle:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise InvalidRequestError("prompt must be a non-empty token list")
@@ -196,15 +213,22 @@ class RequestBroker:
             raise InvalidRequestError(
                 f"prompt ({len(prompt)}) + max_tokens ({mnt}) exceeds the "
                 f"replica's max context {max_ctx}")
-        if temperature is not None and temperature != self.cfg.temperature:
-            # one ragged batch shares one temperature; per-request values
-            # would silently cross-contaminate sampling
+        if temperature is not None and temperature < 0.0:
             raise InvalidRequestError(
-                f"per-request temperature {temperature} != deployment "
-                f"temperature {self.cfg.temperature} (one continuous batch "
-                "shares one sampler)")
+                f"temperature must be >= 0, got {temperature}")
+        # per-tenant SLO class: resolve priority + class deadline
+        cls = slo_class or self.cfg.default_slo_class
+        priority, cls_deadline = 0, None
+        if self.cfg.slo_classes:
+            if cls not in self.cfg.slo_classes:
+                raise InvalidRequestError(
+                    f"unknown SLO class {cls!r} (have "
+                    f"{sorted(self.cfg.slo_classes)})")
+            priority, d = self.cfg.slo_classes[cls]
+            cls_deadline = float(d) if d > 0 else None
         if deadline_s is None:
-            deadline_s = self.cfg.deadline_s
+            deadline_s = cls_deadline if cls_deadline is not None \
+                else self.cfg.deadline_s
         now = time.monotonic()
         req = _Request(
             rid=rid or f"req-{next(_rid_counter)}",
@@ -212,7 +236,12 @@ class RequestBroker:
             stop_ids=frozenset(self.cfg.stop_token_ids) | frozenset(
                 int(t) for t in stop_token_ids),
             deadline=None if deadline_s is None else now + deadline_s,
-            submit_ts=now)
+            submit_ts=now, temperature=temperature,
+            tenant=tenant or "default", slo_class=cls, priority=priority)
+        # rid-derived seed: deterministic across failover resubmits (the
+        # balancer keeps the rid), unique-enough across requests
+        req.seed = int(seed) if seed is not None \
+            else zlib.crc32(req.rid.encode())
         req.trace_id = trace_id or req.rid
         with self._wake:
             if self._stop or self._dead:
@@ -232,7 +261,9 @@ class RequestBroker:
         workload.note_submit(rid=req.rid, t=now, prompt=prompt,
                              max_new_tokens=mnt,
                              stop_token_ids=[int(t) for t in stop_token_ids],
-                             deadline_s=deadline_s)
+                             deadline_s=deadline_s,
+                             temperature=temperature,
+                             tenant=req.tenant, slo_class=cls)
         request_logger(req.rid).info(
             f"serving: submitted to {self.name} "
             f"(prompt={len(prompt)} tok, budget={mnt})")
@@ -389,9 +420,11 @@ class RequestBroker:
             # these and records the final outcome (completed or error)
             self.metrics.record_failover()
         else:
-            self.metrics.record_finish(
-                reason, within_deadline=(req.deadline is None or
-                                         req.finish_ts <= req.deadline))
+            within = (req.deadline is None or req.finish_ts <= req.deadline)
+            self.metrics.record_finish(reason, within_deadline=within)
+            self.metrics.record_tenant_finish(
+                req.tenant, req.slo_class, reason, req.delivered,
+                within_deadline=within)
         if req.uid is not None:
             self._by_uid.pop(req.uid, None)
         self._record_timeline(req)
@@ -473,15 +506,33 @@ class RequestBroker:
                                   f"SLO deadline exceeded after "
                                   f"{now - req.submit_ts:.3f}s")
 
+    def _next_admit_locked(self) -> Optional[_Request]:
+        """Admission order: SLO-class priority first (lower number wins),
+        then tenant fairness — among equal-priority candidates the tenant
+        that was admitted longest ago goes next — then FIFO.  Plain FIFO
+        when no SLO classes are configured (single implicit class)."""
+        if not self._queue:
+            return None
+        if not self.cfg.slo_classes:
+            return self._queue[0]
+        return min(self._queue, key=lambda r: (
+            r.priority, self._tenant_last_admit.get(r.tenant, 0.0),
+            r.submit_ts))
+
     def _admit_locked(self, now: float) -> None:
-        while self._queue:
-            req = self._queue[0]
+        while True:
+            req = self._next_admit_locked()
+            if req is None:
+                break
             try:
                 uid = self.engine.put(req.prompt, req.max_new_tokens,
-                                      strict=True)
+                                      strict=True,
+                                      temperature=req.temperature,
+                                      seed=req.seed)
             except AdmissionError:
                 break  # defer: capacity frees as running requests finish
-            self._queue.popleft()
+            self._queue.remove(req)
+            self._tenant_last_admit[req.tenant] = now
             req.uid = uid
             req.state = RequestState.PREFILL
             req.admit_ts = now

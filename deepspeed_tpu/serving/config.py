@@ -9,7 +9,12 @@ outside the pydantic training-config tree.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
+
+#: replica classes for phase-disaggregated serving (Splitwise/DistServe):
+#: "prefill" replicas take prompt-heavy requests, "decode" replicas take
+#: generation-heavy ones, "mixed" takes anything.
+REPLICA_CLASSES = ("prefill", "decode", "mixed")
 
 
 @dataclasses.dataclass
@@ -123,9 +128,103 @@ class ServingConfig:
     autoscale_backoff_s: float = 1.0
     autoscale_backoff_max_s: float = 30.0
 
+    # -- phase disaggregation (Splitwise/DistServe-shaped) ---------------
+    #: class of THIS worker when run standalone (``serving/worker.py
+    #: --replica_class``); pool-side builds use ``replica_classes``
+    replica_class: str = "mixed"
+    #: class per replica slot, index-aligned with ``num_replicas``; empty
+    #: means every slot is "mixed" (the pre-disaggregation behaviour).
+    #: Slots beyond the tuple's length default to "mixed".
+    replica_classes: Tuple[str, ...] = ()
+    #: request phase classification: a request whose prompt length is at
+    #: least ``phase_prefill_ratio * max_new_tokens`` is prefill-heavy and
+    #: prefers "prefill"-class replicas; everything else prefers "decode".
+    phase_prefill_ratio: float = 4.0
+    #: consult per-replica radix-tree digest summaries (heartbeated) and
+    #: route a request to the replica already holding the longest cached
+    #: prefix of its prompt, overriding the load tiebreak
+    cache_aware_routing: bool = True
+    #: per-class autoscale bounds, e.g. {"decode": (1, 4)}; classes not
+    #: listed fall back to the global ``autoscale_min``/``autoscale_max``.
+    #: Only meaningful with ``autoscale_max > 0``.
+    autoscale_class_bounds: Dict[str, Tuple[int, int]] = dataclasses.field(
+        default_factory=dict)
+
+    # -- per-tenant SLO classes ------------------------------------------
+    #: SLO class table: name -> (priority, deadline_s).  Lower priority
+    #: number = more important (admitted first under pressure, shed last).
+    #: ``deadline_s`` of 0 means "inherit the global deadline_s".
+    slo_classes: Dict[str, Tuple[int, float]] = dataclasses.field(
+        default_factory=dict)
+    #: SLO class applied when a request names none (must be a key of
+    #: ``slo_classes`` when that table is non-empty)
+    default_slo_class: str = "standard"
+
     # -- rolling weight swaps (serving/rollout.py) -----------------------
     #: per-replica drain budget before its swap
     rollout_drain_timeout_s: float = 30.0
     #: post-swap health-probe decode budget (greedy, token-checked)
     rollout_probe_tokens: int = 4
     rollout_probe_timeout_s: float = 120.0
+
+
+# -- CLI spec parsers (shared by the HTTP front and the worker) -------------
+
+
+def parse_replica_classes(text: Optional[str]) -> Tuple[str, ...]:
+    """``"prefill,decode,mixed"`` → per-slot class tuple."""
+    if not text:
+        return ()
+    classes = tuple(c.strip() for c in text.split(",") if c.strip())
+    for c in classes:
+        if c not in REPLICA_CLASSES:
+            raise ValueError(
+                f"unknown replica class {c!r}; valid: {REPLICA_CLASSES}")
+    return classes
+
+
+def parse_slo_classes(text: Optional[str]) -> Dict[str, Tuple[int, float]]:
+    """``"interactive:0:2.5,batch:1:0"`` → {name: (priority, deadline_s)}.
+    Deadline 0 inherits the global ``deadline_s``."""
+    table: Dict[str, Tuple[int, float]] = {}
+    for part in (text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            name, prio, deadline = part.split(":")
+            table[name.strip()] = (int(prio), float(deadline))
+        except ValueError:
+            raise ValueError(
+                f"malformed SLO class {part!r} "
+                "(want NAME:PRIORITY:DEADLINE_S, deadline 0 = inherit)")
+    return table
+
+
+def format_slo_classes(table: Dict[str, Tuple[int, float]]) -> str:
+    """Inverse of :func:`parse_slo_classes` (worker argv serialization)."""
+    return ",".join(f"{name}:{prio}:{deadline}"
+                    for name, (prio, deadline) in sorted(table.items()))
+
+
+def parse_class_bounds(text: Optional[str]
+                       ) -> Dict[str, Tuple[int, int]]:
+    """``"prefill=1:2,decode=1:4"`` → {class: (min, max)} autoscale
+    bounds."""
+    bounds: Dict[str, Tuple[int, int]] = {}
+    for part in (text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            cls, span = part.split("=")
+            lo, hi = span.split(":")
+            cls = cls.strip()
+        except ValueError:
+            raise ValueError(f"malformed class bounds {part!r} "
+                             "(want CLASS=MIN:MAX)")
+        if cls not in REPLICA_CLASSES:
+            raise ValueError(
+                f"unknown replica class {cls!r}; valid: {REPLICA_CLASSES}")
+        bounds[cls] = (int(lo), int(hi))
+    return bounds

@@ -116,6 +116,11 @@ class ServingMetrics:
         # sliding-window rates
         self._win_goodput = _WindowRate(rate_window_s)
         self._win_tokens = _WindowRate(rate_window_s)
+        self._rate_window_s = rate_window_s
+        # per-tenant accounting: (tenant, slo_class) -> counters + windows.
+        # Bounded by the tenant population (operator-configured), not by
+        # request volume.
+        self._tenants: Dict[tuple, Dict] = {}
         # gauges (set by the pool's metrics pump / broker loop)
         self.queue_depth = 0
         self.running = 0
@@ -223,6 +228,42 @@ class ServingMetrics:
                 self.failed += 1
             else:
                 self.failed += 1
+
+    def record_tenant_finish(self, tenant: str, slo_class: str, reason: str,
+                             tokens: int, within_deadline: bool = True) -> None:
+        """Per-tenant disposition: goodput counts length/stop completions
+        within deadline; ``deadline`` sheds move the tenant's shed counter
+        (the per-tenant SLO ledger behind ``dstpu_serving_tenant_*``)."""
+        with self._lock:
+            key = (tenant, slo_class)
+            ent = self._tenants.get(key)
+            if ent is None:
+                ent = self._tenants[key] = {
+                    "completed": 0, "shed": 0, "tokens": 0,
+                    "win_goodput": _WindowRate(self._rate_window_s),
+                    "win_tokens": _WindowRate(self._rate_window_s),
+                }
+            now = self._now()
+            if reason in ("length", "stop"):
+                ent["completed"] += 1
+                ent["tokens"] += int(tokens)
+                ent["win_tokens"].add(float(tokens), now)
+                if within_deadline:
+                    ent["win_goodput"].add(1.0, now)
+            elif reason == "deadline":
+                ent["shed"] += 1
+
+    def tenant_snapshot(self) -> List[Dict[str, float]]:
+        """One row per (tenant, SLO class): sliding-window goodput and
+        token rates plus the monotonic shed counter."""
+        with self._lock:
+            now = self._now()
+            return [{"tenant": t, "slo_class": c,
+                     "goodput_rps": ent["win_goodput"].rate(now),
+                     "tokens_per_s": ent["win_tokens"].rate(now),
+                     "completed": float(ent["completed"]),
+                     "shed_total": float(ent["shed"])}
+                    for (t, c), ent in sorted(self._tenants.items())]
 
     def set_gauges(self, queue_depth: int, running: int,
                    kv_utilization: float) -> None:
@@ -399,6 +440,24 @@ class ServingMetrics:
                    "epoch": str(m.get("epoch", 0))},
                   1.0 if m.get("connected") else 0.0)
                  for i, m in enumerate(registry_members)])
+        tenants = self.tenant_snapshot()
+        if tenants:
+            _TENANT_HELP = {
+                "goodput_rps": "Per-tenant within-SLO completions/s over "
+                               "the sliding window.",
+                "tokens_per_s": "Per-tenant delivered tokens/s over the "
+                                "sliding window.",
+                "shed_total": "Per-tenant requests shed past their SLO "
+                              "class deadline.",
+                "completed": "Per-tenant requests finished with reason "
+                             "length/stop.",
+            }
+            for k, help_text in _TENANT_HELP.items():
+                b.gauge_series(
+                    f"{pre}tenant_{k}", help_text,
+                    [({"tenant": str(row["tenant"]),
+                       "slo_class": str(row["slo_class"])}, float(row[k]))
+                     for row in tenants])
         if replica_stats:
             # "stale" is a label, not a gauge: a dead replica's series keep
             # their last-known values but carry stale="true" so dashboards

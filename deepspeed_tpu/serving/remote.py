@@ -81,7 +81,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
 from ..utils.logging import logger
-from .config import ServingConfig
+from .config import REPLICA_CLASSES, ServingConfig
 from .metrics import ServingMetrics
 from .transport import (FLEET_MAGIC, PROTO_VERSION, FramedReplica,
                         ProtocolError, recv_frame, send_frame)
@@ -100,8 +100,10 @@ class RemoteReplica(FramedReplica):
 
     def __init__(self, config: ServingConfig, name: str,
                  metrics: Optional[ServingMetrics] = None,
-                 launcher: Optional["LocalWorkerLauncher"] = None):
+                 launcher: Optional["LocalWorkerLauncher"] = None,
+                 replica_class: str = "mixed"):
         super().__init__(config, name, metrics=metrics)
+        self.replica_class = replica_class
         self.launcher = launcher
         self.registry: Optional["WorkerRegistry"] = None  # set on register
         self.epoch = 0
@@ -130,7 +132,8 @@ class RemoteReplica(FramedReplica):
         self._force_kill_peer()
         epoch = self.registry.next_epoch(self.name)
         proc = self.launcher.spawn(self.name, self.registry.address, epoch,
-                                   generation=self.generation)
+                                   generation=self.generation,
+                                   replica_class=self.replica_class)
         with self._lock:
             self._proc = proc
         logger.info(f"serving remote: launched worker {self.name} "
@@ -224,6 +227,7 @@ class RemoteReplica(FramedReplica):
         d = super().describe()
         d["epoch"] = self.epoch
         d["externally_managed"] = self.launcher is None
+        d["replica_class"] = self.replica_class
         return d
 
 
@@ -353,6 +357,9 @@ class WorkerRegistry:
         if reason is not None:
             self._reject(conn, rfile, addr, hello, reason)
             return
+        wcls = hello.get("class")
+        if wcls:  # the worker's declared class wins over pool assignment
+            slot.replica_class = str(wcls)
         fenced = slot.healthy()  # live holder about to be severed
         try:
             send_frame(conn, {"ev": "hello_ok", "epoch": granted})
@@ -380,6 +387,8 @@ class WorkerRegistry:
         if self.cfg.fleet_token and \
                 hello.get("token") != self.cfg.fleet_token:
             return "auth_failed", None, 0
+        if hello.get("class", "mixed") not in REPLICA_CLASSES:
+            return "bad_class", None, 0
         name = hello.get("name")
         with self._lock:
             slot = self._slots.get(name)
@@ -434,7 +443,8 @@ class LocalWorkerLauncher:
         self.extra_env = dict(extra_env or {})
 
     def spawn(self, name: str, address: str, epoch: int,
-              generation: int = 0) -> subprocess.Popen:
+              generation: int = 0,
+              replica_class: str = "mixed") -> subprocess.Popen:
         env = dict(os.environ)
         # the worker must import deepspeed_tpu regardless of caller cwd
         pkg_root = os.path.dirname(os.path.dirname(
@@ -449,6 +459,7 @@ class LocalWorkerLauncher:
             [sys.executable, "-m", "deepspeed_tpu.serving.worker",
              "--name", name, "--connect", address, "--epoch", str(epoch),
              "--heartbeat_interval_s", str(self.cfg.heartbeat_interval_s),
+             "--replica_class", replica_class,
              *self.worker_argv],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             env=env, start_new_session=True)

@@ -50,7 +50,8 @@ from ..utils.logging import logger
 from ..utils.proc import terminate_procs
 from .balancer import BalancedHandle, NoReplicaError, ReplicaPool
 from .broker import InvalidRequestError, QueueFullError, RequestFailedError
-from .config import ServingConfig
+from .config import (ServingConfig, format_slo_classes, parse_class_bounds,
+                     parse_replica_classes, parse_slo_classes)
 from .metrics import ServingMetrics
 
 
@@ -253,11 +254,18 @@ class _Handler(BaseHTTPRequestHandler):
         if body.get("n", 1) != 1:
             raise InvalidRequestError("only n=1 is supported")
         prompt = self._parse_prompt(body)
+        seed = body.get("seed")
+        if seed is not None and (isinstance(seed, bool)
+                                 or not isinstance(seed, int)):
+            raise InvalidRequestError("seed must be an integer")
         kwargs = dict(
             max_new_tokens=body.get("max_tokens"),
             temperature=body.get("temperature"),
             deadline_s=body.get("deadline_s"),
             stop_token_ids=body.get("stop_token_ids", ()),
+            seed=seed,
+            tenant=body.get("tenant"),
+            slo_class=body.get("slo_class"),
         )
         handle = self.server.pool.submit(prompt, **kwargs)
         self.server.register(handle)
@@ -429,6 +437,11 @@ def serving_argv_from_config(cfg: ServingConfig) -> List[str]:
     if cfg.stop_token_ids:
         argv += ["--stop_token_ids",
                  ",".join(str(t) for t in cfg.stop_token_ids)]
+    if cfg.slo_classes:
+        # the broker lives in the worker for out-of-process transports —
+        # tenant admission ordering needs the table there, not just here
+        argv += ["--slo_classes", format_slo_classes(cfg.slo_classes),
+                 "--default_slo_class", cfg.default_slo_class]
     return argv
 
 
@@ -451,7 +464,19 @@ def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
                                               "127.0.0.1"),
                         registry_port=getattr(args, "registry_port", 0),
                         autoscale_min=getattr(args, "autoscale_min", 1),
-                        autoscale_max=getattr(args, "autoscale_max", 0))
+                        autoscale_max=getattr(args, "autoscale_max", 0),
+                        replica_classes=parse_replica_classes(
+                            getattr(args, "replica_classes", None)),
+                        phase_prefill_ratio=getattr(
+                            args, "phase_prefill_ratio", 4.0),
+                        cache_aware_routing=not getattr(
+                            args, "no_cache_aware_routing", False),
+                        autoscale_class_bounds=parse_class_bounds(
+                            getattr(args, "autoscale_class_bounds", None)),
+                        slo_classes=parse_slo_classes(
+                            getattr(args, "slo_classes", None)),
+                        default_slo_class=getattr(args, "default_slo_class",
+                                                  "standard"))
     monitor = None
     if args.csv_dir:
         from ..monitor.monitor import CSVMonitor
@@ -534,6 +559,13 @@ def add_serving_cli_args(p) -> None:
     p.add_argument("--idle_wait_s", type=float, default=0.005)
     p.add_argument("--stop_token_ids", default=None,
                    help="comma-separated token ids that end generation")
+    p.add_argument("--slo_classes", default=None,
+                   help="per-tenant SLO class table as "
+                        "NAME:PRIORITY:DEADLINE_S[,...] — lower priority "
+                        "admits first under pressure; deadline 0 inherits "
+                        "--deadline_s")
+    p.add_argument("--default_slo_class", default="standard",
+                   help="SLO class applied when a request names none")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -569,6 +601,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--autoscale_max", type=int, default=0,
                    help="remote transport: autoscaler ceiling "
                         "(0 disables autoscaling)")
+    p.add_argument("--replica_classes", default=None,
+                   help="per-slot replica classes for disaggregated "
+                        "prefill/decode serving, comma-separated and "
+                        "index-aligned with --replicas (e.g. "
+                        "'prefill,decode,decode'); slots beyond the list "
+                        "are 'mixed'")
+    p.add_argument("--phase_prefill_ratio", type=float, default=4.0,
+                   help="a request with prompt_len >= ratio * max_tokens "
+                        "is prefill-heavy and routes to prefill-class "
+                        "replicas")
+    p.add_argument("--no_cache_aware_routing", action="store_true",
+                   help="disable routing on heartbeated prefix-cache "
+                        "digest summaries (fall back to pure "
+                        "least-outstanding-tokens)")
+    p.add_argument("--autoscale_class_bounds", default=None,
+                   help="per-class autoscale bounds as CLASS=MIN:MAX[,...] "
+                        "(e.g. 'decode=1:4'); unlisted classes share the "
+                        "global --autoscale_min/--autoscale_max")
     add_engine_cli_args(p)
     add_serving_cli_args(p)
     p.add_argument("--csv_dir", default=None,

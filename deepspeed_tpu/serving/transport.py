@@ -138,6 +138,11 @@ class ReplicaTransport(abc.ABC):
     the pool's health endpoint and metrics pump call them unconditionally."""
 
     name: str
+    #: phase class for disaggregated routing: "prefill" / "decode" /
+    #: "mixed".  Pool-side assignment (``ServingConfig.replica_classes``)
+    #: for local transports; confirmed by the hello / heartbeat for
+    #: dial-in workers.
+    replica_class: str = "mixed"
 
     @abc.abstractmethod
     def start(self) -> "ReplicaTransport": ...
@@ -176,6 +181,11 @@ class ReplicaTransport(abc.ABC):
     @abc.abstractmethod
     def spec_stats(self) -> Dict[str, float]: ...
 
+    def prefix_summary(self) -> Dict[str, Any]:
+        """Radix-tree digest summary for cache-aware routing (see
+        ``PrefixCache.summary``); empty when the replica has none."""
+        return {}
+
     def describe(self) -> Dict[str, Any]:
         """Transport-specific health extras (process ids, generations)."""
         return {}
@@ -191,6 +201,7 @@ class InProcessReplica(ReplicaTransport):
     def __init__(self, broker: RequestBroker):
         self.broker = broker
         self.name = broker.name
+        self.replica_class = broker.cfg.replica_class
 
     # the serving tests and bench reach through to the engine for leak /
     # block-accounting assertions; only this transport can offer that
@@ -246,6 +257,9 @@ class InProcessReplica(ReplicaTransport):
 
     def spec_stats(self) -> Dict[str, float]:
         return self.broker.engine.spec_stats()
+
+    def prefix_summary(self) -> Dict[str, Any]:
+        return self.broker.engine.prefix_summary()
 
 
 class RemoteHandle:
@@ -309,6 +323,7 @@ class FramedReplica(ReplicaTransport):
                  metrics: Optional[ServingMetrics] = None):
         self.cfg = config
         self.name = name
+        self.replica_class = "mixed"  # pool-assigned; hb/hello confirms
         self.metrics = metrics
         self._lock = threading.Lock()
         self._wlock = threading.Lock()
@@ -417,6 +432,9 @@ class FramedReplica(ReplicaTransport):
                 pid = frame.get("pid")
                 if pid:
                     self._hb_pid = int(pid)
+                cls = self._stats.get("class")
+                if cls:  # the worker's word wins over pool assignment
+                    self.replica_class = str(cls)
             # trace stitching (ISSUE 13): heartbeats piggyback the worker's
             # freshly-completed spans and flight-recorder events; merge
             # them into THIS process's rings so /debug/trace and flight
@@ -582,7 +600,7 @@ class FramedReplica(ReplicaTransport):
             sock = self._sock
         msg = {"op": "submit", "rid": rid, "prompt": list(prompt)}
         for key in ("max_new_tokens", "temperature", "deadline_s",
-                    "stop_token_ids"):
+                    "stop_token_ids", "seed", "tenant", "slo_class"):
             if kwargs.get(key) is not None:
                 msg[key] = kwargs[key] if key != "stop_token_ids" \
                     else list(kwargs[key])
@@ -710,6 +728,9 @@ class FramedReplica(ReplicaTransport):
 
     def spec_stats(self) -> Dict[str, float]:
         return dict(self._stat("spec", {}))
+
+    def prefix_summary(self) -> Dict[str, Any]:
+        return dict(self._stat("prefix_summary", {}))
 
     # -- supervisor surface ----------------------------------------------
 

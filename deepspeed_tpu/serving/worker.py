@@ -68,7 +68,7 @@ from ..utils.backoff import decorrelated_jitter
 from ..utils.logging import logger
 from .broker import (BrokerStoppedError, InvalidRequestError, QueueFullError,
                      RequestBroker, RequestFailedError)
-from .config import ServingConfig
+from .config import ServingConfig, parse_slo_classes
 from .transport import (FLEET_MAGIC, PROTO_VERSION, READY_MARKER,
                         recv_frame, send_frame)
 
@@ -93,8 +93,12 @@ def _stats(broker: RequestBroker) -> dict:
         "kv_utilization": broker.kv_utilization(),
         "running": eng.num_running,
         "waiting": eng.num_waiting,
+        "class": broker.cfg.replica_class,
         "prefix": eng.prefix_stats(),
         "spec": eng.spec_stats(),
+        # radix-tree digest summary for the pool's cache-aware routing;
+        # capped so a hot cache can't bloat the heartbeat frame
+        "prefix_summary": eng.prefix_summary(max_digests=256),
     }
 
 
@@ -234,7 +238,10 @@ def _serve_conn(conn: socket.socket, broker: RequestBroker, name: str,
                         deadline_s=frame.get("deadline_s"),
                         stop_token_ids=frame.get("stop_token_ids", ()),
                         rid=rid,
-                        trace_id=trace_ctx.get("trace_id"))
+                        trace_id=trace_ctx.get("trace_id"),
+                        seed=frame.get("seed"),
+                        tenant=frame.get("tenant"),
+                        slo_class=frame.get("slo_class"))
                 except QueueFullError as e:
                     send_frame(conn, {"ev": "rejected", "rid": rid,
                                       "etype": "queue_full",
@@ -345,9 +352,11 @@ def _dial(args, epoch: Optional[int], prev_epoch: Optional[int]):
     conn = socket.create_connection((host, int(port)), timeout=10.0)
     try:
         conn.settimeout(_HELLO_TIMEOUT_S)
+        # "class" is the only wire change for phase disaggregation: the
+        # registry validates it and the pool routes by it
         hello = {"op": "hello", "magic": FLEET_MAGIC,
                  "version": PROTO_VERSION, "name": args.name,
-                 "pid": os.getpid()}
+                 "pid": os.getpid(), "class": args.replica_class}
         token = os.environ.get("DSTPU_FLEET_TOKEN")
         if token:
             hello["token"] = token
@@ -439,6 +448,9 @@ def main(argv: Optional[list] = None) -> int:
                    help="fencing epoch for the first registration "
                         "(launcher-assigned; reconnects negotiate)")
     p.add_argument("--heartbeat_interval_s", type=float, default=0.25)
+    p.add_argument("--replica_class", default="mixed",
+                   choices=("prefill", "decode", "mixed"),
+                   help="phase class for disaggregated routing")
     add_engine_cli_args(p)
     add_serving_cli_args(p)
     args = p.parse_args(argv)
@@ -458,7 +470,10 @@ def main(argv: Optional[list] = None) -> int:
         if args.stop_token_ids else (),
         idle_wait_s=args.idle_wait_s,
         num_replicas=1,
-        heartbeat_interval_s=args.heartbeat_interval_s)
+        heartbeat_interval_s=args.heartbeat_interval_s,
+        replica_class=args.replica_class,
+        slo_classes=parse_slo_classes(args.slo_classes),
+        default_slo_class=args.default_slo_class)
     logger.info(f"worker {args.name}: building engine (model={args.model})")
     broker = RequestBroker(build_engine_factory(args)(), scfg,
                            name=args.name)

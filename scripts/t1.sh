@@ -146,6 +146,17 @@ then
     exit 2
 fi
 
+# disaggregated-serving suite: imports the phase-class balancer routing,
+# the KV prefix-handoff path, and the per-tenant SLO accounting
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_disagg.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_disagg.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi
@@ -162,8 +173,11 @@ fi
 T1_GROUPS=${T1_GROUPS:-6}
 # test_remote_fleet gets its own partition (appended below): its loopback-
 # TCP fleets bind ephemeral registry ports and spawn scripted worker
-# processes, and must not share a pytest process with engine-heavy suites
-mapfile -t T1_FILES < <(ls tests/test_*.py | grep -v 'test_remote_fleet' | sort)
+# processes, and must not share a pytest process with engine-heavy suites.
+# test_disagg likewise: its multi-replica pools compile several engine
+# variants (prefix cache on/off, max_seqs overrides) in one process
+mapfile -t T1_FILES < <(ls tests/test_*.py \
+    | grep -v -e 'test_remote_fleet' -e 'test_disagg' | sort)
 rc=0
 rm -f /tmp/_t1.log
 for ((g = 0; g < T1_GROUPS; g++)); do
@@ -185,6 +199,15 @@ for ((g = 0; g < T1_GROUPS; g++)); do
         rc=$grc
     fi
 done
+echo "== t1: group disagg: tests/test_disagg.py =="
+timeout -k 10 1800 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_disagg.py -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
+grc=${PIPESTATUS[0]}
+if [ "$grc" -ne 0 ] && [ "$grc" -ne 5 ]; then
+    rc=$grc
+fi
 echo "== t1: group remote-fleet: tests/test_remote_fleet.py =="
 timeout -k 10 1800 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_remote_fleet.py -q -m 'not slow' \

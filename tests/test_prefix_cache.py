@@ -381,8 +381,12 @@ def test_decode_program_identical_with_cache(devices, tiny_model):
         pos = np.zeros((seqs,), np.int32)
         tables = np.zeros((seqs, eng.cfg.max_blocks_per_seq), np.int32)
         ctx = np.ones((seqs,), np.int32)
+        temps = np.zeros((seqs,), np.float32)
+        seeds = np.zeros((seqs,), np.int32)
         return eng._decode_fwd.lower(eng.params, eng.caches, toks, pos,
-                                     tables, ctx).as_text()
+                                     tables, ctx, temps,
+                                     jax.random.PRNGKey(0),
+                                     seeds).as_text()
 
     assert lowered(True) == lowered(False)
 

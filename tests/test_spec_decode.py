@@ -245,7 +245,8 @@ def test_sampled_acceptance_preserves_target_distribution(devices):
         draft = jax.random.categorical(
             dk, jnp.log(q + 1e-20), axis=-1).astype(jnp.int32)
         emitted, _ = _accept_and_emit(logits, draft, q, ak,
-                                      jnp.asarray(1.0, jnp.float32))
+                                      jnp.ones((1,), jnp.float32),
+                                      jnp.zeros((1,), jnp.int32))
         return emitted[0, 0]
 
     toks = np.asarray(jax.jit(jax.vmap(one))(jax.random.split(r3, N)))
