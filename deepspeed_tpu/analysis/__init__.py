@@ -20,7 +20,13 @@ parsing bugs.  This package is the single implementation:
   registry (train_step@zero{0..3}, train_step@lora, decode_step@v2,
   onebit_step) compiled over virtual meshes;
 * ``python -m deepspeed_tpu.analysis`` — compiles the flagship programs
-  and emits a JSON report + pass/fail against the budgets.
+  and emits a JSON report + pass/fail against the budgets;
+* :mod:`~deepspeed_tpu.analysis.concurrency` — the concurrency gates:
+  lockdep waiver discipline (``waivers.toml``, backing the
+  ``DSTPU_LOCKDEP=1`` runtime in ``utils/locks.py``) and the static
+  frame-protocol exhaustiveness check over the serving wire protocol;
+* :mod:`~deepspeed_tpu.analysis.strict_toml` — the shared strict-TOML
+  validation both declarative gates (budgets, waivers) route through.
 
 Reference for the role: ``deepspeed/compile/`` (compile-time graph
 passes) and the flops profiler — here the compiler already did the
@@ -59,6 +65,16 @@ from .budgets import (
     default_budgets_path,
     load_budgets,
 )
+from .concurrency import (
+    ConcurrencyError,
+    apply_waivers,
+    check_frame_protocol,
+    extract_protocol,
+    format_violation,
+    load_waivers,
+    summary_line,
+)
+from .strict_toml import StrictTomlError
 
 __all__ = [
     "DTYPE_BITS",
@@ -86,4 +102,12 @@ __all__ = [
     "check_budgets",
     "default_budgets_path",
     "load_budgets",
+    "ConcurrencyError",
+    "StrictTomlError",
+    "apply_waivers",
+    "check_frame_protocol",
+    "extract_protocol",
+    "format_violation",
+    "load_waivers",
+    "summary_line",
 ]

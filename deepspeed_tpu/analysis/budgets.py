@@ -28,6 +28,8 @@ import dataclasses
 import os
 from typing import Any, Dict, List, Optional
 
+from .strict_toml import StrictTomlError, check_keys, load_toml, require
+
 __all__ = [
     "BudgetError",
     "BudgetViolation",
@@ -37,8 +39,10 @@ __all__ = [
 ]
 
 
-class BudgetError(ValueError):
-    """Malformed budget file (unknown key, bad type, missing table)."""
+class BudgetError(StrictTomlError):
+    """Malformed budget file (unknown key, bad type, missing table).
+    Shares the strict-TOML discipline (``strict_toml.py``) with the
+    lockdep waiver checker."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,22 +88,17 @@ def default_budgets_path() -> str:
 
 def load_budgets(path: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
     """Load and validate the budget file; returns {program: budget table}."""
-    import tomli
-
     path = path or default_budgets_path()
-    with open(path, "rb") as f:
-        data = tomli.load(f)
+    data = load_toml(path)
+    check_keys(data, {"programs"}, path, error=BudgetError)
     programs = data.get("programs")
     if not isinstance(programs, dict) or not programs:
         raise BudgetError(f"{path}: missing [programs.\"<name>\"] tables")
     for name, table in programs.items():
         if not isinstance(table, dict):
             raise BudgetError(f"{path}: programs.{name} is not a table")
-        unknown = set(table) - _PROGRAM_KEYS
-        if unknown:
-            raise BudgetError(
-                f"{path}: unknown budget key(s) {sorted(unknown)} for "
-                f"program {name!r}; known keys: {sorted(_PROGRAM_KEYS)}")
+        check_keys(table, _PROGRAM_KEYS, f"{path}: programs.{name}",
+                   error=BudgetError)
         mc = table.get("max_collectives", {})
         if not isinstance(mc, dict):
             raise BudgetError(
@@ -115,10 +114,10 @@ def load_budgets(path: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
 
 def _require(report: Dict[str, Any], pass_name: str, program: str) -> Dict:
     p = report.get("passes", {}).get(pass_name)
-    if p is None or "error" in p or "skipped" in p:
-        raise BudgetError(
+    require(p is not None and "error" not in p and "skipped" not in p,
             f"budget for {program!r} needs pass {pass_name!r} but the "
-            f"report has {p!r} — a budget must never pass vacuously")
+            f"report has {p!r} — a budget must never pass vacuously",
+            error=BudgetError)
     return p
 
 
@@ -215,11 +214,10 @@ def check_budgets(report: Dict[str, Any],
 
     if "max_temp_bytes" in budget:
         mem = report.get("memory")
-        if not mem or "temp_bytes" not in mem:
-            raise BudgetError(
+        require(bool(mem) and "temp_bytes" in mem,
                 f"budget for {program!r} sets max_temp_bytes but the report "
                 f"carries no XLA memory stats — a budget must never pass "
-                f"vacuously")
+                f"vacuously", error=BudgetError)
         _ceiling("memory.temp_bytes", mem["temp_bytes"],
                  budget["max_temp_bytes"])
 

@@ -1,0 +1,294 @@
+"""Concurrency correctness gates: lockdep waivers + protocol exhaustiveness.
+
+Two halves, both consumed by tier-1 (``scripts/t1.sh``):
+
+**Waiver checking** for the runtime lockdep in ``utils/locks.py``.  A
+``DSTPU_LOCKDEP=1`` run accumulates violations (lock-order cycles and
+blocking-calls-under-lock); ``tests/conftest.py`` asserts the set empty
+at session teardown *modulo* ``analysis/waivers.toml``.  Waivers follow
+the ``budgets.toml`` discipline (``strict_toml.py``): unknown keys and
+vacuous entries (no key, no justification) are hard errors — zero
+silent suppressions.  Violation keys are stable strings::
+
+    cycle:<A>-><B>->...-><A>     # rotated so the smallest class leads
+    blocking:<lock-class>:<call> # e.g. blocking:transport.write:socket.sendall
+
+**Frame-protocol exhaustiveness** for the fleet wire protocol
+(``serving/transport.py`` / ``worker.py`` / ``remote.py``).  A static
+AST pass extracts every frame-type literal *produced* (``{"op": ...}`` /
+``{"ev": ...}`` dict literals) and every literal *handled* (``op ==
+"submit"``, ``ev in ("swap_ok", "swap_err")``, ``frame.get("ev") !=
+"hello_ok")`` comparisons) and errors on a send with no handler (a
+frame the peer drops on the floor) or a dead handler (a branch no
+sender can reach — usually a renamed frame type).
+
+CLI (the tier-1 static gate)::
+
+    python -m deepspeed_tpu.analysis.concurrency          # both checks
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .strict_toml import StrictTomlError, check_keys, load_toml, require
+
+__all__ = [
+    "ConcurrencyError",
+    "apply_waivers",
+    "check_frame_protocol",
+    "default_protocol_paths",
+    "default_waivers_path",
+    "extract_protocol",
+    "format_violation",
+    "load_waivers",
+    "summary_line",
+]
+
+
+class ConcurrencyError(StrictTomlError):
+    """Malformed waiver file or a failed protocol-exhaustiveness check."""
+
+
+# -- waivers --------------------------------------------------------------
+
+_WAIVER_KEYS = {"key", "reason"}
+_WAIVER_PREFIXES = ("cycle:", "blocking:")
+
+
+def default_waivers_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "waivers.toml")
+
+
+def load_waivers(path: Optional[str] = None) -> Dict[str, str]:
+    """Load and validate the waiver file; returns {violation key: reason}.
+
+    Strict on principle: unknown top-level or entry keys, a key that is
+    not a ``cycle:``/``blocking:`` violation key, an empty reason, or a
+    duplicate entry are all hard errors."""
+    path = path or default_waivers_path()
+    data = load_toml(path)
+    check_keys(data, {"waiver"}, path, error=ConcurrencyError)
+    entries = data.get("waiver", [])
+    require(isinstance(entries, list),
+            f"{path}: [[waiver]] must be an array of tables",
+            error=ConcurrencyError)
+    out: Dict[str, str] = {}
+    for i, ent in enumerate(entries):
+        where = f"{path}: waiver[{i}]"
+        require(isinstance(ent, dict), f"{where}: not a table",
+                error=ConcurrencyError)
+        check_keys(ent, _WAIVER_KEYS, where, error=ConcurrencyError)
+        key = ent.get("key")
+        require(isinstance(key, str) and key.startswith(_WAIVER_PREFIXES),
+                f"{where}: 'key' must be a full violation key starting "
+                f"with one of {_WAIVER_PREFIXES}, got {key!r} — a waiver "
+                f"that can never match is vacuous", error=ConcurrencyError)
+        reason = ent.get("reason")
+        require(isinstance(reason, str) and reason.strip() != "",
+                f"{where}: waiver for {key!r} carries no 'reason' — "
+                f"every suppression must be justified in the file",
+                error=ConcurrencyError)
+        require(key not in out, f"{where}: duplicate waiver for {key!r}",
+                error=ConcurrencyError)
+        out[key] = reason.strip()
+    return out
+
+
+def apply_waivers(report: Dict[str, Any],
+                  waivers: Dict[str, str]) -> Dict[str, Any]:
+    """Split a ``lockdep_report()`` into waived and unwaived violations.
+
+    Returns ``{"unwaived": [...], "waived": [...], "unused_waivers":
+    [...]}``.  Unused waivers are surfaced (a partitioned test group may
+    simply not exercise that path) but are not themselves a failure."""
+    violations = list(report.get("cycles", ())) + \
+        list(report.get("blocking", ()))
+    unwaived: List[Dict[str, Any]] = []
+    waived: List[Dict[str, Any]] = []
+    used: Set[str] = set()
+    for v in violations:
+        if v["key"] in waivers:
+            waived.append(v)
+            used.add(v["key"])
+        else:
+            unwaived.append(v)
+    return {"unwaived": unwaived, "waived": waived,
+            "unused_waivers": sorted(set(waivers) - used)}
+
+
+def format_violation(v: Dict[str, Any]) -> str:
+    """Human-readable violation with its acquire sites."""
+    lines = [v["key"] + f"  (seen {v.get('count', 1)}x)"]
+    if v["key"].startswith("cycle:"):
+        for e in v.get("edges", ()):
+            lines.append(f"  {e['from']} -> {e['to']}:")
+            lines.append(f"    {e['from']} held at:")
+            lines.extend(f"      {s}" for s in e.get("hold_site", ()))
+            lines.append(f"    {e['to']} acquired at:")
+            lines.extend(f"      {s}" for s in e.get("acquire_site", ()))
+    else:
+        lines.append(f"  {v['call']} while holding {v['lock']}:")
+        lines.extend(f"    {s}" for s in v.get("site", ()))
+        lines.append(f"  {v['lock']} acquired at:")
+        lines.extend(f"    {s}" for s in v.get("hold_site", ()))
+    return "\n".join(lines)
+
+
+def summary_line(report: Dict[str, Any], waived: int) -> str:
+    """The one-line summary t1.sh prints next to DOTS_PASSED."""
+    return (f"LOCKDEP locks={len(report.get('locks', ()))} "
+            f"edges={len(report.get('edges', ()))} "
+            f"cycles={len(report.get('cycles', ()))} "
+            f"blocking={len(report.get('blocking', ()))} "
+            f"waived={waived}")
+
+
+# -- frame-protocol exhaustiveness ----------------------------------------
+
+#: the fleet wire protocol lives in exactly these three files
+_PROTOCOL_FILES = ("transport.py", "worker.py", "remote.py")
+#: frame discriminator keys: pool->worker ops, worker->pool events
+_CHANNELS = ("op", "ev")
+
+
+def default_protocol_paths() -> List[str]:
+    serving = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "serving")
+    return [os.path.join(serving, f) for f in _PROTOCOL_FILES]
+
+
+def _channel_of(node: ast.AST) -> Optional[str]:
+    """If ``node`` reads a frame discriminator, return its channel:
+    the name ``op``/``ev``, ``<x>.get("op"/"ev")``, or
+    ``<x>["op"/"ev"]``."""
+    if isinstance(node, ast.Name) and node.id in _CHANNELS:
+        return node.id
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "get" and node.args and \
+            isinstance(node.args[0], ast.Constant) and \
+            node.args[0].value in _CHANNELS:
+        return node.args[0].value
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and sl.value in _CHANNELS:
+            return sl.value
+    return None
+
+
+def _str_consts(node: ast.AST) -> Optional[List[str]]:
+    """String literal(s) on the other side of a comparison: a constant
+    or a tuple/list/set of constants; None if anything is non-literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def extract_protocol(source: str, path: str = "<memory>") -> Dict[str, Any]:
+    """Extract frame-type literals from one protocol file.
+
+    Returns ``{"sent": {channel: {literal: [lines]}}, "handled": ...}``.
+    *Sent* is any dict literal with an ``"op"``/``"ev"`` key mapping to
+    a string constant (whether passed to ``send_frame`` directly, built
+    in a variable, or injected into a local ack/ctrl queue — a produced
+    frame needs a handler wherever it surfaces).  *Handled* is any
+    comparison of a discriminator read against string literal(s)."""
+    tree = ast.parse(source, filename=path)
+    sent: Dict[str, Dict[str, List[int]]] = {c: {} for c in _CHANNELS}
+    handled: Dict[str, Dict[str, List[int]]] = {c: {} for c in _CHANNELS}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value in _CHANNELS \
+                        and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    sent[k.value].setdefault(v.value, []).append(
+                        node.lineno)
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            chan = None
+            lits: List[str] = []
+            for side in sides:
+                c = _channel_of(side)
+                if c is not None:
+                    chan = c
+                    continue
+                s = _str_consts(side)
+                if s is not None:
+                    lits.extend(s)
+            if chan is not None and lits:
+                for lit in lits:
+                    handled[chan].setdefault(lit, []).append(node.lineno)
+    return {"sent": sent, "handled": handled}
+
+
+def check_frame_protocol(
+        paths: Optional[Sequence[str]] = None) -> List[str]:
+    """Cross-file exhaustiveness: every sent frame type must have a
+    handler somewhere in the protocol files, and every handled literal
+    must be sent by someone.  Returns a list of problem strings."""
+    paths = list(paths) if paths is not None else default_protocol_paths()
+    sent: Dict[str, Dict[str, List[str]]] = {c: {} for c in _CHANNELS}
+    handled: Dict[str, Dict[str, List[str]]] = {c: {} for c in _CHANNELS}
+    for p in paths:
+        with open(p, "r") as f:
+            ex = extract_protocol(f.read(), p)
+        base = os.path.basename(p)
+        for chan in _CHANNELS:
+            for lit, lns in ex["sent"][chan].items():
+                sent[chan].setdefault(lit, []).extend(
+                    f"{base}:{ln}" for ln in lns)
+            for lit, lns in ex["handled"][chan].items():
+                handled[chan].setdefault(lit, []).extend(
+                    f"{base}:{ln}" for ln in lns)
+    problems: List[str] = []
+    for chan in _CHANNELS:
+        for lit in sorted(set(sent[chan]) - set(handled[chan])):
+            problems.append(
+                f"frame {chan}={lit!r} is sent ({', '.join(sent[chan][lit])}) "
+                f"but no handler compares against it — the peer drops it "
+                f"on the floor")
+        for lit in sorted(set(handled[chan]) - set(sent[chan])):
+            problems.append(
+                f"frame {chan}={lit!r} is handled "
+                f"({', '.join(handled[chan][lit])}) but never sent — dead "
+                f"handler (renamed or removed frame type?)")
+    return problems
+
+
+# -- CLI (the t1.sh static gate) ------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    rc = 0
+    try:
+        waivers = load_waivers()
+        print(f"concurrency: waivers.toml OK ({len(waivers)} waiver(s))")
+    except (OSError, StrictTomlError) as e:
+        print(f"concurrency: WAIVER FILE INVALID: {e}", file=sys.stderr)
+        rc = 1
+    problems = check_frame_protocol()
+    if problems:
+        for p in problems:
+            print(f"concurrency: PROTOCOL: {p}", file=sys.stderr)
+        rc = 1
+    else:
+        print("concurrency: frame protocol exhaustive "
+              f"({', '.join(_PROTOCOL_FILES)})")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,8 +18,9 @@ from __future__ import annotations
 
 import math
 import re
-import threading
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.locks import named_lock
 
 # ---------------------------------------------------------------------------
 # histogram primitive
@@ -40,7 +41,7 @@ class Histogram:
         if bs != sorted(bs) or len(set(bs)) != len(bs):
             raise ValueError("histogram buckets must be strictly increasing")
         self.buckets: Tuple[float, ...] = tuple(bs)
-        self._lock = threading.Lock()
+        self._lock = named_lock("prom.histogram")
         # per-bucket (non-cumulative) counts; +Inf overflow is _counts[-1]
         self._counts = [0] * (len(bs) + 1)
         self.sum = 0.0

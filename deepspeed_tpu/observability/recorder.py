@@ -32,6 +32,7 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
+from ..utils.locks import named_lock
 from ..utils.logging import logger
 
 _ENV_DIR = "DSTPU_FLIGHT_DIR"
@@ -44,7 +45,7 @@ class FlightRecorder:
 
     def __init__(self, max_requests: int = 256, max_steps: int = 512,
                  max_events: int = 256):
-        self._lock = threading.Lock()
+        self._lock = named_lock("recorder.rings")
         self._requests: Deque[Dict[str, Any]] = deque(maxlen=max_requests)
         self._steps: Deque[Dict[str, Any]] = deque(maxlen=max_steps)
         self._events: Deque[Dict[str, Any]] = deque(maxlen=max_events)

@@ -51,6 +51,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..utils.locks import named_lock
+
 __all__ = [
     "ChaosEvent",
     "SLOError",
@@ -210,7 +212,7 @@ def load_workload(path: str
 # capture at the broker
 # ---------------------------------------------------------------------------
 
-_capture_lock = threading.Lock()
+_capture_lock = named_lock("replay.capture_install")
 _capture: Optional["WorkloadCapture"] = None
 
 
@@ -225,7 +227,7 @@ class WorkloadCapture:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("replay.capture")
         self._t0: Optional[float] = None
         self._by_rid: Dict[str, Dict[str, Any]] = {}
         self._order: List[str] = []

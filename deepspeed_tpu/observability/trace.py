@@ -38,6 +38,8 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Deque, Dict, Iterator, List, Optional
 
+from ..utils.locks import named_lock
+
 _ENV = "DSTPU_TRACE"
 
 
@@ -75,7 +77,7 @@ class Tracer:
     """Process-wide span ring (module singleton ``tracer`` below)."""
 
     def __init__(self, capacity: int = 8192, enabled: Optional[bool] = None):
-        self._lock = threading.Lock()
+        self._lock = named_lock("trace.ring")
         self._ring: Deque[Span] = deque(maxlen=capacity)
         self._ids = itertools.count(1)
         self._seq = itertools.count(1)  # ring-append order (export cursor)

@@ -36,6 +36,7 @@ from ..monitor.monitor import Monitor
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
 from ..utils.backoff import decorrelated_jitter
+from ..utils.locks import named_lock
 from ..utils.logging import logger, request_logger
 from .broker import (BrokerStoppedError, QueueFullError, RequestBroker,
                      RequestFailedError)
@@ -163,7 +164,7 @@ class ReplicaPool:
         self.monitor = monitor
         self._accepting = False
         self._rr = 0  # round-robin tiebreak cursor
-        self._lock = threading.Lock()
+        self._lock = named_lock("pool.state")
         self._pump: Optional[threading.Thread] = None
         self._pump_stop = threading.Event()
         self._emit_step = 0

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import List, Optional
 
+from ..utils.locks import named_lock
 from .metrics import _percentile
 from .server import launch_server_subprocess, stop_server
 
@@ -74,7 +75,7 @@ def sweep_point(host: str, port: int, rate_rps: float, duration_s: float,
     ``prompt_fn(i)`` overrides prompt construction (prefix-heavy mode)."""
     out = {"completed": 0, "rejected": 0, "failed": 0, "tokens": 0,
            "ttft_s": [], "e2e_s": []}
-    lock = threading.Lock()
+    lock = named_lock("bench.stats")
     threads = []
     n = int(rate_rps * duration_s)
     t0 = time.monotonic()
@@ -120,7 +121,7 @@ def run_sweep(rates: List[float], duration_s: float = 8.0,
         # warm the compile caches so the sweep measures serving, not XLA
         warm = {"completed": 0, "rejected": 0, "failed": 0, "tokens": 0,
                 "ttft_s": [], "e2e_s": []}
-        _one_request(host, port, [1, 2, 3], 4, warm, threading.Lock())
+        _one_request(host, port, [1, 2, 3], 4, warm, named_lock("bench.stats"))
         points = [sweep_point(host, port, r, duration_s, max_tokens,
                               prompt_len) for r in rates]
     finally:
@@ -196,16 +197,16 @@ def run_prefix_sweep(rates: List[float], duration_s: float = 6.0,
             warm = {"completed": 0, "rejected": 0, "failed": 0, "tokens": 0,
                     "ttft_s": [], "e2e_s": []}
             _one_request(host, port, probe, max_tokens, warm,
-                         threading.Lock())
+                         named_lock("bench.stats"))
             for tpl in templates:
                 _one_request(host, port, tpl + [252] * suffix_len, max_tokens,
-                             warm, threading.Lock())
+                             warm, named_lock("bench.stats"))
             ttfts: List[float] = []
             for _ in range(repeats):
                 m = {"completed": 0, "rejected": 0, "failed": 0, "tokens": 0,
                      "ttft_s": [], "e2e_s": []}
                 _one_request(host, port, probe, max_tokens, m,
-                             threading.Lock())
+                             named_lock("bench.stats"))
                 ttfts.extend(m["ttft_s"])
 
             def prompt_fn(i):

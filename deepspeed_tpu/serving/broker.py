@@ -38,6 +38,7 @@ from ..observability import replay as workload
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
 from ..utils import faults
+from ..utils.locks import named_lock
 from ..utils.logging import logger, request_logger
 from .config import ServingConfig
 from .metrics import ServingMetrics
@@ -171,7 +172,7 @@ class RequestBroker:
         self.metrics = metrics or ServingMetrics()
         self.name = name
         self._own_gauges = own_gauges  # pool-managed brokers leave gauges to the pump
-        self._lock = threading.Lock()
+        self._lock = named_lock("broker.state")
         self._wake = threading.Condition(self._lock)
         self._queue: Deque[_Request] = deque()
         # tenant -> monotonic ts of its last admission (fairness ordering)

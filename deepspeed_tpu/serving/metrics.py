@@ -28,6 +28,7 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence
 from ..monitor.monitor import Event, Monitor
 from ..observability.prometheus import (DEFAULT_MS_BUCKETS,
                                         ExpositionBuilder, Histogram)
+from ..utils.locks import named_lock
 
 
 def _percentile(samples: List[float], q: float) -> float:
@@ -94,7 +95,7 @@ class _WindowRate:
 class ServingMetrics:
     def __init__(self, rate_window_s: float = 60.0,
                  now_fn: Callable[[], float] = time.monotonic):
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.state")
         self._now = now_fn
         self.ttft_ms = _Reservoir()   # submit → first generated token
         self.tpot_ms = _Reservoir()   # inter-token gap during decode

@@ -80,6 +80,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
+from ..utils.locks import named_lock
 from ..utils.logging import logger
 from .config import REPLICA_CLASSES, ServingConfig
 from .metrics import ServingMetrics
@@ -243,7 +244,7 @@ class WorkerRegistry:
                  metrics: Optional[ServingMetrics] = None):
         self.cfg = config
         self.metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = named_lock("registry.state")
         self._slots: Dict[str, RemoteReplica] = {}
         self._epochs: Dict[str, int] = {}
         self._lsock: Optional[socket.socket] = None

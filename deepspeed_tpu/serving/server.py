@@ -46,6 +46,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
+from ..utils.locks import named_lock
 from ..utils.logging import logger
 from ..utils.proc import terminate_procs
 from .balancer import BalancedHandle, NoReplicaError, ReplicaPool
@@ -85,11 +86,11 @@ class ServingHTTPServer(ThreadingHTTPServer):
         self.encode = encode or _default_encode
         self.decode = decode or _default_decode
         self._handles = {}  # rid -> BalancedHandle (live requests)
-        self._handles_lock = threading.Lock()
+        self._handles_lock = named_lock("server.handles")
         # /debug/profile serialization: jax.profiler.trace is process-wide
         # and not reentrant — a second overlapping capture must get a clean
         # 409, not a mid-capture crash (ISSUE 13 satellite)
-        self.profile_lock = threading.Lock()
+        self.profile_lock = named_lock("server.profile")
 
     def handle_error(self, request, client_address):  # noqa: N802
         import sys as _sys

@@ -58,6 +58,7 @@ from typing import List, Optional, Sequence
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
 from ..utils.backoff import exponential_backoff
+from ..utils.locks import named_lock
 from ..utils.logging import logger
 from .config import ServingConfig
 from .metrics import ServingMetrics
@@ -81,7 +82,7 @@ class ReplicaSupervisor:
         self.metrics = metrics
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._members_lock = threading.Lock()
+        self._members_lock = named_lock("supervisor.members")
 
     # -- lifecycle -------------------------------------------------------
 

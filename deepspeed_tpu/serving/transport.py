@@ -72,6 +72,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
+from ..utils.locks import named_lock
 from ..utils.logging import logger
 from ..utils.proc import terminate_procs
 from .broker import (BrokerStoppedError, InvalidRequestError, QueueFullError,
@@ -105,7 +106,9 @@ def send_frame(sock: socket.socket, obj: Dict[str, Any],
     data = _LEN.pack(len(payload)) + payload
     if lock is not None:
         with lock:
-            sock.sendall(data)
+            # waived (analysis/waivers.toml): serializing frames onto the
+            # socket is this lock's purpose; close() unblocks, not writers
+            sock.sendall(data)  # lint: allow(blocking-in-lock)
     else:
         sock.sendall(data)
 
@@ -325,8 +328,11 @@ class FramedReplica(ReplicaTransport):
         self.name = name
         self.replica_class = "mixed"  # pool-assigned; hb/hello confirms
         self.metrics = metrics
-        self._lock = threading.Lock()
-        self._wlock = threading.Lock()
+        # lock classes (utils/locks.py): "transport.state" guards the
+        # replica's connection/stream maps, "transport.write" serializes
+        # whole frames onto the socket.  Never hold state across a write.
+        self._lock = named_lock("transport.state")
+        self._wlock = named_lock("transport.write")
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._pending: Dict[str, RemoteHandle] = {}

@@ -65,6 +65,7 @@ from ..observability.recorder import recorder
 from ..observability.trace import tracer
 from ..utils import faults
 from ..utils.backoff import decorrelated_jitter
+from ..utils.locks import named_lock
 from ..utils.logging import logger
 from .broker import (BrokerStoppedError, InvalidRequestError, QueueFullError,
                      RequestBroker, RequestFailedError)
@@ -133,7 +134,7 @@ class _HeartbeatState:
         self.pid = os.getpid()
         self.span_cursor = 0
         self.event_cursor = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("worker.hb_state")
 
     def frame(self, broker: RequestBroker) -> dict:
         hb = {"ev": "hb", "stats": _stats(broker),
@@ -210,7 +211,7 @@ def _serve_conn(conn: socket.socket, broker: RequestBroker, name: str,
     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     if rfile is None:
         rfile = conn.makefile("rb")
-    wlock = threading.Lock()
+    wlock = named_lock("worker.write")
     hb_stop = threading.Event()
     hb_thread = threading.Thread(
         target=_heartbeat_loop,
@@ -290,7 +291,7 @@ def _finish(conn: socket.socket, broker: RequestBroker,
     # final span/event flush: drained requests finalize during stop(), and
     # their timelines must reach the front before the socket closes
     try:
-        send_frame(conn, hb_state.frame(broker), threading.Lock())
+        send_frame(conn, hb_state.frame(broker), named_lock("worker.write"))
     except OSError:
         pass
     try:
@@ -376,9 +377,15 @@ def _dial(args, epoch: Optional[int], prev_epoch: Optional[int]):
     if reply is None:
         conn.close()
         raise ConnectionError("registry closed during hello")
-    if reply.get("ev") != "hello_ok":
+    ev = reply.get("ev")
+    if ev == "hello_err":
         conn.close()
         raise PermissionError(reply.get("reason", "rejected"))
+    if ev != "hello_ok":
+        # neither verdict frame: a corrupted or foreign peer — as fatal
+        # as a rejection (retrying cannot make it speak the protocol)
+        conn.close()
+        raise PermissionError(f"unexpected hello reply: {ev!r}")
     conn.settimeout(None)
     return conn, rfile, int(reply["epoch"])
 

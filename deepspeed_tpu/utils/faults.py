@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Union
 
+from .locks import named_lock
 from .logging import logger
 
 _ENV = "DSTPU_FAULTS"
@@ -78,7 +79,7 @@ class FaultInjector:
     """Process-wide registry of armed fault sites (module singleton below)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.registry")
         self._specs: Dict[str, _Spec] = {}
         self._hits: Dict[str, int] = {}
         self._fired: Dict[str, int] = {}

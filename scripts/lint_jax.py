@@ -21,6 +21,22 @@ Rules:
                   lowers to a host callback in every compiled program
                   that traces through it
 
+Concurrency rules (serving/, observability/, utils/ — the lockdep
+surface, see deepspeed_tpu/utils/locks.py):
+
+  bare-lock       threading.Lock()/RLock() outside utils/locks.py —
+                  every lock must be a named_lock()/named_rlock() so the
+                  DSTPU_LOCKDEP runtime can order-check it
+  blocking-in-lock  a known-blocking call (time.sleep, socket
+                  send/sendall/recv/accept, queue get/put, thread/proc
+                  join/wait) lexically inside a `with <lock>:` body —
+                  the static half of lockdep's held-across-blocking-call
+                  check (the runtime half catches what lexing can't)
+  wall-clock-interval  time.time() as an operand of interval/timeout
+                  arithmetic in serving//observability/ — wall clocks
+                  jump (NTP, suspend); lease/heartbeat/deadline math
+                  must use time.monotonic()
+
 A finding is suppressed by an inline marker naming its rule, e.g.::
 
     self._update = jax.jit(update_step)  # lint: allow(jit-no-donate) — buffers reused by caller
@@ -42,6 +58,28 @@ HOT_NAME_RE = re.compile(r"(^|_)(step|update)")
 HOST_SYNC_ATTRS = ("block_until_ready", "item")
 DONATE_KWARGS = ("donate_argnums", "donate_argnames")
 _ALLOW_RE = re.compile(r"lint:\s*allow\(([\w\-, ]+)\)")
+
+#: directories under the concurrency lint (must use utils/locks.py)
+LOCKDEP_DIRS = ("/serving/", "/observability/", "/utils/")
+#: queue-shaped receiver for the lexical .get/.put blocking rule
+_QUEUEISH_RE = re.compile(r"(^q$|_q$|queue)")
+
+
+def _in_lockdep_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(d in p for d in LOCKDEP_DIRS) and \
+        not p.endswith("utils/locks.py")
+
+
+def _final_name(node: ast.AST) -> str:
+    """Rightmost identifier of an expression (x -> x, a.b.c -> c)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        return _final_name(node.func)
+    return ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -192,10 +230,134 @@ class _FileLint:
                         "compiles a host callback into every program "
                         "tracing through it")
 
+    # -- rule: bare-lock (serving/observability/utils) -------------------
+
+    def _threading_aliases(self):
+        """(module aliases of threading, local names bound to
+        threading.Lock/RLock via from-imports)."""
+        mods = set()
+        ctors = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "threading":
+                        mods.add(a.asname or "threading")
+            elif isinstance(node, ast.ImportFrom) and \
+                    node.module == "threading":
+                for a in node.names:
+                    if a.name in ("Lock", "RLock"):
+                        ctors.add(a.asname or a.name)
+        return mods, ctors
+
+    def _scan_bare_locks(self) -> None:
+        if not _in_lockdep_scope(self.path):
+            return
+        mods, ctors = self._threading_aliases()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            bare = (isinstance(f, ast.Attribute) and
+                    f.attr in ("Lock", "RLock") and
+                    isinstance(f.value, ast.Name) and f.value.id in mods) \
+                or (isinstance(f, ast.Name) and f.id in ctors)
+            if bare:
+                kind = f.attr if isinstance(f, ast.Attribute) else f.id
+                self._add(
+                    "bare-lock", node.lineno,
+                    f"bare threading.{kind}() in lockdep territory — use "
+                    f"named_{'r' if kind == 'RLock' else ''}lock(\"<class>\")"
+                    f" from deepspeed_tpu.utils.locks so DSTPU_LOCKDEP can "
+                    f"order-check it")
+
+    # -- rule: blocking-in-lock (lexical half of lockdep) ----------------
+
+    def _is_blocking_call(self, node: ast.Call) -> Optional[str]:
+        """Name of the blocking primitive ``node`` invokes, or None."""
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            recv = _final_name(f.value).lower()
+            if f.attr == "sleep" and isinstance(f.value, ast.Name) and \
+                    f.value.id == "time":
+                return "time.sleep"
+            if f.attr in ("sendall", "send", "recv", "recv_into", "accept"):
+                return f".{f.attr}"
+            if f.attr in ("get", "put") and _QUEUEISH_RE.search(recv):
+                return f"queue .{f.attr}"
+            if f.attr == "join" and ("thread" in recv or "proc" in recv
+                                     or recv == "t"):
+                return ".join"
+            if f.attr == "wait" and "wake" not in recv and \
+                    "cond" not in recv and "cv" not in recv:
+                return ".wait"
+        elif isinstance(f, ast.Name) and f.id == "sleep":
+            return "sleep"
+        return None
+
+    def _scan_blocking_in_lock(self) -> None:
+        if not _in_lockdep_scope(self.path):
+            return
+        seen = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lock_names = [n for n in
+                          (_final_name(it.context_expr)
+                           for it in node.items)
+                          if "lock" in n.lower()]
+            if not lock_names:
+                continue
+            for sub in node.body:
+                for call in ast.walk(sub):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    what = self._is_blocking_call(call)
+                    if what is None or call.lineno in seen:
+                        continue
+                    seen.add(call.lineno)
+                    self._add(
+                        "blocking-in-lock", call.lineno,
+                        f"{what} inside `with {lock_names[0]}:` — a "
+                        f"blocking call under a lock stalls every waiter "
+                        f"(and is half of every deadlock); move it outside "
+                        f"the critical section or waive it in "
+                        f"analysis/waivers.toml + an allow marker")
+
+    # -- rule: wall-clock-interval (serving/observability) ---------------
+
+    def _scan_wall_clock(self) -> None:
+        p = self.path.replace("\\", "/")
+        if "/serving/" not in p and "/observability/" not in p:
+            return
+        def _is_wall(node: ast.AST) -> bool:
+            return isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "time" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "time"
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.BinOp):
+                operands = (node.left, node.right)
+            elif isinstance(node, ast.Compare):
+                operands = (node.left, *node.comparators)
+            else:
+                continue
+            for op in operands:
+                if _is_wall(op):
+                    self._add(
+                        "wall-clock-interval", op.lineno,
+                        "time.time() used in interval/deadline arithmetic "
+                        "— wall clocks jump (NTP, suspend); use "
+                        "time.monotonic() for durations and keep "
+                        "time.time() for timestamps only")
+
     def run(self) -> List[Finding]:
         jitted = self._scan_jits()
         self._scan_host_syncs(jitted)
         self._scan_debug_prints()
+        self._scan_bare_locks()
+        self._scan_blocking_in_lock()
+        self._scan_wall_clock()
         return self.findings
 
 

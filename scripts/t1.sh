@@ -23,6 +23,17 @@ if ! timeout -k 10 60 python scripts/lint_jax.py; then
     exit 2
 fi
 
+echo "== t1: concurrency static gates =="
+# (a) the lint above also enforces bare-lock / blocking-in-lock /
+# wall-clock-interval; (b) this gate checks the lockdep waiver file is
+# strict-valid and the fleet frame protocol is exhaustive: every
+# {"op"/"ev": ...} literal sent across transport/worker/remote has a
+# handler comparing against it, and no handler is dead (pure AST)
+if ! timeout -k 10 60 python -m deepspeed_tpu.analysis.concurrency; then
+    echo "t1: CONCURRENCY GATE FAILED (deepspeed_tpu/analysis/concurrency.py)" >&2
+    exit 2
+fi
+
 echo "== t1: collection gate =="
 if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' --collect-only \
@@ -175,9 +186,14 @@ T1_GROUPS=${T1_GROUPS:-6}
 # TCP fleets bind ephemeral registry ports and spawn scripted worker
 # processes, and must not share a pytest process with engine-heavy suites.
 # test_disagg likewise: its multi-replica pools compile several engine
-# variants (prefix cache on/off, max_seqs overrides) in one process
+# variants (prefix cache on/off, max_seqs overrides) in one process.
+# test_fleet gets its own partition too so the three chaos-heavy suites
+# (fleet/remote-fleet/disagg) can run under DSTPU_LOCKDEP=1 — every
+# failover/fencing/autoscale path is lock-order-checked on every CI run
+# (conftest.pytest_sessionfinish asserts the report empty mod waivers)
 mapfile -t T1_FILES < <(ls tests/test_*.py \
-    | grep -v -e 'test_remote_fleet' -e 'test_disagg' | sort)
+    | grep -v -e 'test_remote_fleet' -e 'test_disagg' -e 'test_fleet\.py' \
+    | sort)
 rc=0
 rm -f /tmp/_t1.log
 for ((g = 0; g < T1_GROUPS; g++)); do
@@ -199,8 +215,17 @@ for ((g = 0; g < T1_GROUPS; g++)); do
         rc=$grc
     fi
 done
-echo "== t1: group disagg: tests/test_disagg.py =="
-timeout -k 10 1800 env JAX_PLATFORMS=cpu \
+echo "== t1: group fleet (lockdep): tests/test_fleet.py =="
+timeout -k 10 1800 env JAX_PLATFORMS=cpu DSTPU_LOCKDEP=1 \
+    python -m pytest tests/test_fleet.py -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
+grc=${PIPESTATUS[0]}
+if [ "$grc" -ne 0 ] && [ "$grc" -ne 5 ]; then
+    rc=$grc
+fi
+echo "== t1: group disagg (lockdep): tests/test_disagg.py =="
+timeout -k 10 1800 env JAX_PLATFORMS=cpu DSTPU_LOCKDEP=1 \
     python -m pytest tests/test_disagg.py -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
@@ -208,8 +233,8 @@ grc=${PIPESTATUS[0]}
 if [ "$grc" -ne 0 ] && [ "$grc" -ne 5 ]; then
     rc=$grc
 fi
-echo "== t1: group remote-fleet: tests/test_remote_fleet.py =="
-timeout -k 10 1800 env JAX_PLATFORMS=cpu \
+echo "== t1: group remote-fleet (lockdep): tests/test_remote_fleet.py =="
+timeout -k 10 1800 env JAX_PLATFORMS=cpu DSTPU_LOCKDEP=1 \
     python -m pytest tests/test_remote_fleet.py -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
@@ -217,5 +242,10 @@ grc=${PIPESTATUS[0]}
 if [ "$grc" -ne 0 ] && [ "$grc" -ne 5 ]; then
     rc=$grc
 fi
+# lockdep aggregate: sum the per-process "LOCKDEP locks=..." lines the
+# conftest sessionfinish hook printed in the DSTPU_LOCKDEP=1 partitions
+echo "LOCKDEP_SUMMARY $(grep -a '^LOCKDEP locks=' /tmp/_t1.log \
+    | awk -F'[= ]' '{l+=$3; e+=$5; c+=$7; b+=$9; w+=$11} END {
+        printf "locks=%d edges=%d cycles=%d blocking=%d waived=%d runs=%d", l, e, c, b, w, NR}')"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 exit $rc

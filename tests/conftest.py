@@ -39,3 +39,37 @@ def _reset_topology():
     from deepspeed_tpu.parallel import topology
 
     topology.reset_topology()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Lockdep gate (ISSUE 17): under ``DSTPU_LOCKDEP=1`` every suite in
+    this pytest process ran with named-lock order tracking; assert the
+    accumulated report empty modulo ``analysis/waivers.toml`` and print
+    the one-line summary t1.sh aggregates next to DOTS_PASSED.  Runs
+    after capture teardown, so the output always reaches the log."""
+    from deepspeed_tpu.utils import locks
+
+    if not locks.lockdep_enabled():
+        return
+    from deepspeed_tpu.analysis import concurrency
+
+    report = locks.lockdep_report()
+    try:
+        waivers = concurrency.load_waivers()
+    except Exception as e:  # noqa: BLE001 — a bad waiver file must fail
+        # the run loudly, not crash the hook half-printed
+        print(f"\nLOCKDEP WAIVER FILE INVALID: {e}")
+        session.exitstatus = 1
+        return
+    split = concurrency.apply_waivers(report, waivers)
+    print("\n" + concurrency.summary_line(report, len(split["waived"])))
+    for key in split["unused_waivers"]:
+        # not an error: partitioned tier-1 groups don't all exercise
+        # every waived path
+        print(f"LOCKDEP note: waiver unused in this session: {key}")
+    if split["unwaived"]:
+        print(f"LOCKDEP FAILED: {len(split['unwaived'])} unwaived "
+              f"violation(s):")
+        for v in split["unwaived"]:
+            print(concurrency.format_violation(v))
+        session.exitstatus = 1

@@ -377,7 +377,7 @@ def test_budget_alias_fraction_requires_intent():
 def test_budget_file_rejects_unknown_keys(tmp_path):
     bad = tmp_path / "budgets.toml"
     bad.write_text('[programs."p"]\nmax_colectives_typo = 3\n')
-    with pytest.raises(BudgetError, match="unknown budget key"):
+    with pytest.raises(BudgetError, match="unknown key"):
         load_budgets(str(bad))
 
 

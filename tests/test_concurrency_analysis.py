@@ -1,0 +1,442 @@
+"""Concurrency correctness layer (ISSUE 17): lockdep runtime
+(utils/locks.py), waiver discipline + frame-protocol exhaustiveness
+(analysis/concurrency.py), and the three concurrency lint rules
+(scripts/lint_jax.py).
+
+The lockdep tests force DSTPU_LOCKDEP=1 per test and reset the global
+graph afterwards, so they are safe inside a lockdep-enabled tier-1
+partition: nothing they record leaks into the session-teardown gate."""
+
+import importlib.util
+import os
+import socket as socket_mod
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.analysis import concurrency
+from deepspeed_tpu.analysis.budgets import BudgetError, load_budgets
+from deepspeed_tpu.analysis.strict_toml import StrictTomlError
+from deepspeed_tpu.utils import locks
+
+
+@pytest.fixture
+def lockdep(monkeypatch):
+    """Lockdep on, clean graph before and after."""
+    monkeypatch.setenv("DSTPU_LOCKDEP", "1")
+    locks.lockdep_reset()
+    yield locks
+    locks.lockdep_reset()
+
+
+# ---------------------------------------------------------------------------
+# lockdep runtime: cycles, reentrancy, blocking calls
+# ---------------------------------------------------------------------------
+
+
+def test_abba_cycle_detected_with_both_acquire_sites(lockdep):
+    A = locks.named_lock("t17.A")
+    B = locks.named_lock("t17.B")
+
+    def order_ab():
+        with A:
+            with B:
+                pass
+
+    def order_ba():
+        with B:
+            with A:
+                pass
+
+    order_ab()
+    order_ba()
+    rep = locks.lockdep_report()
+    keys = [c["key"] for c in rep["cycles"]]
+    assert "cycle:t17.A->t17.B->t17.A" in keys
+    cyc = next(c for c in rep["cycles"] if c["key"] == keys[0])
+    # both edges of the inversion are reported ...
+    edge_pairs = {(e["from"], e["to"]) for e in cyc["edges"]}
+    assert edge_pairs == {("t17.A", "t17.B"), ("t17.B", "t17.A")}
+    # ... each with the acquire site of the offending `with` statement:
+    # the A->B edge was created inside order_ab, B->A inside order_ba
+    by_pair = {(e["from"], e["to"]): e for e in cyc["edges"]}
+    ab_site = "\n".join(by_pair[("t17.A", "t17.B")]["acquire_site"])
+    ba_site = "\n".join(by_pair[("t17.B", "t17.A")]["acquire_site"])
+    assert "order_ab" in ab_site and "test_concurrency_analysis" in ab_site
+    assert "order_ba" in ba_site
+    # the holding end is contextualized too (where A / B were taken)
+    assert "order_ab" in "\n".join(by_pair[("t17.A", "t17.B")]["hold_site"])
+
+
+def test_cycle_key_is_rotation_stable(lockdep):
+    # the same inversion seen from the other side produces the SAME key
+    # (canonical rotation: smallest class leads) so one waiver covers it
+    X = locks.named_lock("t17.zz")
+    Y = locks.named_lock("t17.aa")
+    with X:
+        with Y:
+            pass
+    with Y:
+        with X:
+            pass
+    rep = locks.lockdep_report()
+    assert [c["key"] for c in rep["cycles"]] == \
+        ["cycle:t17.aa->t17.zz->t17.aa"]
+
+
+def test_rlock_reentrancy_is_not_a_cycle(lockdep):
+    R = locks.named_rlock("t17.R")
+
+    def recurse(n):
+        with R:
+            if n:
+                recurse(n - 1)
+
+    recurse(3)
+    rep = locks.lockdep_report()
+    assert rep["cycles"] == []
+    assert rep["edges"] == []
+
+
+def test_two_instances_same_class_nested_is_a_self_cycle(lockdep):
+    # two *different* Lock instances of one class nested IS an order
+    # hazard (thread 1 takes a->b, thread 2 takes b->a): self-edge cycle
+    a = locks.named_lock("t17.peer")
+    b = locks.named_lock("t17.peer")
+    with a:
+        with b:
+            pass
+    rep = locks.lockdep_report()
+    assert [c["key"] for c in rep["cycles"]] == \
+        ["cycle:t17.peer->t17.peer"]
+
+
+def test_blocking_calls_under_lock_flagged(lockdep):
+    import queue
+
+    L = locks.named_lock("t17.hold")
+    bounded = queue.Queue(maxsize=1)
+    unbounded = queue.Queue()
+    with L:
+        time.sleep(0.001)
+        unbounded.put(1)      # unbounded put never blocks: NOT a violation
+        bounded.put(1)        # bounded put can block: violation
+        bounded.get()         # blocking get: violation
+    rep = locks.lockdep_report()
+    got = sorted(b["key"] for b in rep["blocking"])
+    assert got == ["blocking:t17.hold:queue.Queue.get",
+                   "blocking:t17.hold:queue.Queue.put",
+                   "blocking:t17.hold:time.sleep"]
+    sleep_rec = next(b for b in rep["blocking"]
+                     if b["call"] == "time.sleep")
+    assert any("test_blocking_calls_under_lock_flagged" in s
+               for s in sleep_rec["site"])
+
+
+def test_no_lock_held_means_no_blocking_violation(lockdep):
+    locks.named_lock("t17.idle")  # enables patches
+    time.sleep(0.001)
+    assert locks.lockdep_report()["blocking"] == []
+
+
+def test_condition_over_named_lock(lockdep):
+    # broker._wake idiom: Condition(lock) must wait/notify through the
+    # wrapper without recording spurious edges or losing ownership
+    L = locks.named_lock("t17.cond")
+    cv = threading.Condition(L)
+    woke = threading.Event()
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=2.0)
+        woke.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    assert woke.wait(2.0)
+    assert locks.lockdep_report()["cycles"] == []
+
+
+def test_try_acquire_release_idiom(lockdep):
+    # server.profile_lock idiom: acquire(blocking=False) / release()
+    L = locks.named_lock("t17.try")
+    assert L.acquire(blocking=False)
+    assert not L.acquire(blocking=False)
+    L.release()
+    assert L.acquire(blocking=False)
+    L.release()
+    assert locks.lockdep_report()["cycles"] == []
+
+
+def test_passthrough_when_disabled(monkeypatch):
+    monkeypatch.delenv("DSTPU_LOCKDEP", raising=False)
+    L = locks.named_lock("t17.off")
+    assert isinstance(L, type(threading.Lock()))
+
+
+def test_close_io_ordering_stays_cycle_free(lockdep):
+    """Regression for the PR-13 deadlock fix: _close_io shuts the socket
+    down *before* close so a reader blocked in recv (holding its buffer
+    lock) unblocks instead of wedging close.  Under lockdep, closing
+    while a reader is parked must complete and record no lock cycles."""
+    from deepspeed_tpu.serving.transport import FramedReplica
+
+    a, b = socket_mod.socketpair()
+    rfile = a.makefile("rb")
+    state = locks.named_lock("transport.state")
+    unblocked = threading.Event()
+
+    def reader():
+        rfile.read(4)  # parks in recv until shutdown
+        unblocked.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    # the dispatch path takes transport.state *around* the teardown, the
+    # way _declare_down does (socket handed out of the locked region)
+    with state:
+        sock, rf = a, rfile
+    FramedReplica._close_io(sock, rf)
+    assert unblocked.wait(2.0), "_close_io failed to unblock the reader"
+    t.join(2.0)
+    rep = locks.lockdep_report()
+    assert rep["cycles"] == []
+    assert not [v for v in rep["blocking"]
+                if v["lock"] == "transport.state"]
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# waivers: strict-TOML roundtrip + shared loader discipline
+# ---------------------------------------------------------------------------
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "waivers.toml"
+    p.write_text(textwrap.dedent(text))
+    return str(p)
+
+
+def test_waiver_roundtrip(tmp_path):
+    path = _write(tmp_path, """\
+        [[waiver]]
+        key = "blocking:transport.write:socket.sendall"
+        reason = "the lock IS the frame serializer"
+
+        [[waiver]]
+        key = "cycle:a->b->a"
+        reason = "historical, tracked in #000"
+    """)
+    w = concurrency.load_waivers(path)
+    assert w == {
+        "blocking:transport.write:socket.sendall":
+            "the lock IS the frame serializer",
+        "cycle:a->b->a": "historical, tracked in #000",
+    }
+
+
+def test_waiver_unknown_key_rejected(tmp_path):
+    path = _write(tmp_path, """\
+        [[waiver]]
+        key = "cycle:a->b->a"
+        reason = "fine"
+        justification = "typo'd field"
+    """)
+    with pytest.raises(concurrency.ConcurrencyError,
+                       match="unknown key.*justification"):
+        concurrency.load_waivers(path)
+
+
+def test_waiver_unknown_toplevel_rejected(tmp_path):
+    path = _write(tmp_path, """\
+        [[waivers]]
+        key = "cycle:a->b->a"
+        reason = "wrong table name"
+    """)
+    with pytest.raises(concurrency.ConcurrencyError, match="unknown key"):
+        concurrency.load_waivers(path)
+
+
+@pytest.mark.parametrize("body", [
+    # vacuous: no reason
+    '[[waiver]]\nkey = "cycle:a->b->a"\n',
+    # vacuous: empty reason
+    '[[waiver]]\nkey = "cycle:a->b->a"\nreason = "  "\n',
+    # not a violation key: can never match
+    '[[waiver]]\nkey = "sendall"\nreason = "r"\n',
+    # duplicate entries
+    '[[waiver]]\nkey = "cycle:a->b->a"\nreason = "x"\n'
+    '[[waiver]]\nkey = "cycle:a->b->a"\nreason = "y"\n',
+])
+def test_vacuous_waivers_rejected(tmp_path, body):
+    path = _write(tmp_path, body)
+    with pytest.raises(concurrency.ConcurrencyError):
+        concurrency.load_waivers(path)
+
+
+def test_apply_waivers_split(lockdep):
+    A = locks.named_lock("t17.wv.a")
+    B = locks.named_lock("t17.wv.b")
+    with A:
+        with B:
+            pass
+    with B:
+        with A:
+            pass
+    with A:
+        time.sleep(0.001)
+    rep = locks.lockdep_report()
+    waivers = {"blocking:t17.wv.a:time.sleep": "test", "cycle:nope->x->nope": "unused"}
+    split = concurrency.apply_waivers(rep, waivers)
+    assert [v["key"] for v in split["waived"]] == \
+        ["blocking:t17.wv.a:time.sleep"]
+    assert [v["key"] for v in split["unwaived"]] == \
+        ["cycle:t17.wv.a->t17.wv.b->t17.wv.a"]
+    assert split["unused_waivers"] == ["cycle:nope->x->nope"]
+    # and the human rendering carries the sites
+    text = concurrency.format_violation(split["unwaived"][0])
+    assert "t17.wv.a -> t17.wv.b" in text
+
+
+def test_repo_waiver_file_is_valid():
+    w = concurrency.load_waivers()
+    assert "blocking:transport.write:socket.sendall" in w
+
+
+def test_summary_line_format(lockdep):
+    locks.named_lock("t17.fmt")
+    line = concurrency.summary_line(locks.lockdep_report(), waived=2)
+    assert line.startswith("LOCKDEP locks=")
+    assert "cycles=0" in line and "waived=2" in line
+
+
+def test_budget_loader_shares_strict_toml(tmp_path):
+    # the two gates share one validation helper: BudgetError IS a
+    # StrictTomlError, and unknown budget keys still hard-error
+    assert issubclass(BudgetError, StrictTomlError)
+    assert issubclass(concurrency.ConcurrencyError, StrictTomlError)
+    p = tmp_path / "budgets.toml"
+    p.write_text('[programs."x"]\nmax_host_syncs = 0\ntypo_key = 1\n')
+    with pytest.raises(BudgetError, match="unknown key.*typo_key"):
+        load_budgets(str(p))
+
+
+# ---------------------------------------------------------------------------
+# frame-protocol exhaustiveness
+# ---------------------------------------------------------------------------
+
+
+def test_protocol_extraction():
+    src = textwrap.dedent("""\
+        def pool(sock, q):
+            send_frame(sock, {"op": "submit", "rid": 1})
+            msg = {"op": "stop"}
+            q.put({"ev": "rejected"})
+
+        def worker(frame, reply):
+            op = frame.get("op")
+            if op == "submit":
+                pass
+            elif op in ("stop", "drain"):
+                pass
+            if reply.get("ev") != "rejected":
+                pass
+            if frame["ev"] == "hb":
+                pass
+    """)
+    ex = concurrency.extract_protocol(src)
+    assert set(ex["sent"]["op"]) == {"submit", "stop"}
+    assert set(ex["sent"]["ev"]) == {"rejected"}
+    assert set(ex["handled"]["op"]) == {"submit", "stop", "drain"}
+    assert set(ex["handled"]["ev"]) == {"rejected", "hb"}
+
+
+def test_protocol_mismatch_detected(tmp_path):
+    a = tmp_path / "sender.py"
+    a.write_text('def f(s):\n    send_frame(s, {"op": "reboot"})\n')
+    b = tmp_path / "handler.py"
+    b.write_text('def g(op):\n    if op == "halt":\n        pass\n')
+    problems = concurrency.check_frame_protocol([str(a), str(b)])
+    assert len(problems) == 2
+    joined = "\n".join(problems)
+    assert "op='reboot' is sent" in joined and "no handler" in joined
+    assert "op='halt' is handled" in joined and "never sent" in joined
+
+
+def test_repo_protocol_is_exhaustive():
+    assert concurrency.check_frame_protocol() == []
+
+
+# ---------------------------------------------------------------------------
+# lint rules (scripts/lint_jax.py, loaded by path)
+# ---------------------------------------------------------------------------
+
+
+def _lint_mod():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                        "lint_jax.py")
+    spec = importlib.util.spec_from_file_location("lint_jax17", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lint_bare_lock_scoped():
+    lint = _lint_mod()
+    src = "import threading\nx = threading.Lock()\n"
+    in_scope = lint.lint_source(src, "deepspeed_tpu/serving/foo.py")
+    assert [f.rule for f in in_scope] == ["bare-lock"]
+    # out of the lockdep dirs: allowed
+    assert lint.lint_source(src, "deepspeed_tpu/nvme/foo.py") == []
+    # the factory itself is exempt
+    assert lint.lint_source(src, "deepspeed_tpu/utils/locks.py") == []
+
+
+def test_lint_blocking_in_lock():
+    lint = _lint_mod()
+    src = textwrap.dedent("""\
+        import time
+
+        def f(self):
+            with self._lock:
+                time.sleep(1)
+                self._stats.get("k")
+            with self._wake:
+                self._wake.wait()
+    """)
+    found = lint.lint_source(src, "deepspeed_tpu/serving/foo.py")
+    # sleep flagged; dict .get and Condition wait (non-lock name) are not
+    assert [(f.rule, f.line) for f in found] == [("blocking-in-lock", 5)]
+    allowed = src.replace("time.sleep(1)",
+                          "time.sleep(1)  # lint: allow(blocking-in-lock)")
+    assert lint.lint_source(allowed, "deepspeed_tpu/serving/foo.py") == []
+
+
+def test_lint_wall_clock_interval():
+    lint = _lint_mod()
+    src = textwrap.dedent("""\
+        import time
+        start = time.monotonic()
+        stamp = int(time.time())
+        d = {"wall": time.time()}
+        dt = time.time() - start
+    """)
+    found = lint.lint_source(src, "deepspeed_tpu/observability/foo.py")
+    assert [(f.rule, f.line) for f in found] == [("wall-clock-interval", 5)]
+    # rule is scoped to serving/ + observability/
+    assert lint.lint_source(src, "deepspeed_tpu/runtime/foo.py") == []
+
+
+def test_lint_repo_is_clean():
+    lint = _lint_mod()
+    from pathlib import Path
+    root = Path(__file__).resolve().parent.parent / "deepspeed_tpu"
+    assert [str(f) for f in lint.lint_paths([root])] == []

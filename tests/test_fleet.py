@@ -491,6 +491,14 @@ def test_fleet_hang_detected_by_missed_heartbeats(fleet_pool, ref_fn,
 def test_fleet_hung_engine_detected_while_busy(fleet_pool, ref_fn):
     _fleet_heal(fleet_pool)
     hung0 = fleet_pool.metrics.fleet["hung_detected"]
+    # warm BOTH replicas first: earlier chaos tests leave respawned
+    # generations with cold jit caches, and a legitimate first-compile
+    # step (~2s on CPU) must not trip the shrunken threshold below
+    seen = set()
+    while len(seen) < 2:
+        h = fleet_pool.submit([2, 8, 5], max_new_tokens=16)
+        assert list(h.tokens(timeout=180)) == ref_fn([2, 8, 5], 16)
+        seen.add(h.replica_index)
     # shrink the hung threshold only now — past warmup, so no legitimate
     # first-compile can trip it (cfg is read live by the supervisor)
     fleet_pool.cfg.hung_replica_timeout_s = 2.0

@@ -477,11 +477,18 @@ def test_remove_replica_concurrent_single_release(remote_fleet):
 
 
 class _FakeReplica:
-    def __init__(self, name):
+    def __init__(self, name, pool=None):
         self.name = name
+        self.pool = pool
 
     def healthy(self):
         return True
+
+    def queue_depth(self):
+        # per-replica share of the pool-level knob the tests drive
+        if self.pool is None:
+            return 0
+        return self.pool.queue / max(1, len(self.pool.replicas))
 
     def outstanding_tokens(self):
         return 0
@@ -491,7 +498,7 @@ class _FakePool:
     def __init__(self, n, cfg):
         self.cfg = cfg
         self.metrics = ServingMetrics()
-        self.replicas = [_FakeReplica(f"replica{i}") for i in range(n)]
+        self.replicas = [_FakeReplica(f"replica{i}", self) for i in range(n)]
         self._quiesced = set()
         self.autoscaler = None
         self.queue = 0
@@ -504,11 +511,11 @@ class _FakePool:
     def queue_depth(self):
         return self.queue
 
-    def spawn_remote_replica(self, name=None):
+    def spawn_remote_replica(self, name=None, replica_class=None):
         if self.spawn_error is not None:
             raise self.spawn_error
         name = name or f"replica{len(self.replicas)}"
-        self.replicas = self.replicas + [_FakeReplica(name)]
+        self.replicas = self.replicas + [_FakeReplica(name, self)]
         self.spawned.append(name)
         return name
 

@@ -235,7 +235,7 @@ def test_broker_rejects_invalid(devices, tiny_model):
     with pytest.raises(InvalidRequestError):
         broker.submit([1], max_new_tokens=200)  # exceeds max context
     with pytest.raises(InvalidRequestError):
-        broker.submit([1], max_new_tokens=4, temperature=0.7)  # != deployment
+        broker.submit([1], max_new_tokens=4, temperature=-1.0)  # negative
 
 
 # ---------------------------------------------------------------------------
