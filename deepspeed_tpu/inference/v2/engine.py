@@ -71,6 +71,13 @@ class V2Config:
     enable_prefix_cache: bool = False
     prefix_cache_min_tokens: int = 0  # min shareable prefix to take a hit
     prefix_eviction: str = "lru"  # "lru" | "none"
+    # serving memory hierarchy (inference/v2/paging.py): demote cold prefix
+    # blocks to a host-DRAM pool (and optionally disk) instead of evicting,
+    # so a returning session promotes instead of recomputing.  All paging
+    # is host-side: the compiled prefill/decode HLO is identical on/off.
+    kv_host_pool_mb: int = 0  # 0 disables the paging tier entirely
+    kv_spill_dir: str = ""  # third tier: safetensors spill files (optional)
+    kv_promote_ahead: bool = False  # background disk→host prefetch thread
     # speculative decoding (inference/v2/spec.py): "draft" proposes with a
     # small second model, "self_draft" with Medusa-style bolt-on heads
     # (linear/spec_heads.py); spec_k tokens proposed per step, verified in
@@ -461,6 +468,7 @@ class InferenceEngineV2:
                                  self.cfg.max_blocks_per_seq)
         self.prefix_cache = None
         self._cow_copy = None
+        self.pager = None
         if self.cfg.enable_prefix_cache:
             from .prefix_cache import PrefixCache
 
@@ -470,6 +478,15 @@ class InferenceEngineV2:
                 eviction=self.cfg.prefix_eviction)
             self.kv.prefix_cache = self.prefix_cache
             self._cow_copy = build_cow_copy()
+            if self.cfg.kv_host_pool_mb > 0:
+                from .paging import BlockPager
+
+                self.pager = BlockPager(
+                    host_bytes=self.cfg.kv_host_pool_mb << 20,
+                    spill_dir=self.cfg.kv_spill_dir,
+                    promote_ahead=self.cfg.kv_promote_ahead)
+                self.prefix_cache.attach_pager(
+                    self.pager, self._demote_node, self._promote_node)
         self.builder = RaggedBatchBuilder(self.cfg.max_tokens_per_step,
                                           self.cfg.max_seqs,
                                           self.cfg.max_blocks_per_seq)
@@ -612,6 +629,11 @@ class InferenceEngineV2:
             "enabled": 0, "lookups": 0, "hits": 0, "hit_rate": 0.0,
             "prefill_tokens_skipped": 0, "evictions": 0, "cow_copies": 0,
             "cached_blocks": 0, "shared_blocks": 0, "evictable_blocks": 0,
+            # memory-hierarchy tiers (inference/v2/paging.py); ride the
+            # worker heartbeat into /healthz and the balancer aggregate
+            "tier_device_blocks": 0, "tier_host_blocks": 0,
+            "tier_spill_blocks": 0, "demotions": 0, "promotions": 0,
+            "promote_wait_ms": 0.0,
         }
         if self.prefix_cache is not None:
             stats.update(self.prefix_cache.stats())
@@ -710,6 +732,95 @@ class InferenceEngineV2:
         self.prefix_cache.donate(tokens[:covered], covered, blocks)
         return covered
 
+    # -- serving memory hierarchy (inference/v2/paging.py) ---------------
+
+    def _read_kv_block(self, block: int) -> Dict[str, np.ndarray]:
+        """One block's k/v bytes as host arrays (the pager's demote input;
+        same layout ``export_prefix`` ships between replicas)."""
+        return {
+            "k": np.ascontiguousarray(np.asarray(self.caches["k"][:, block])),
+            "v": np.ascontiguousarray(np.asarray(self.caches["v"][:, block])),
+        }
+
+    def _demote_node(self, node) -> Optional[Tuple[int, str]]:
+        """Prefix-cache demote callback: serialize the node's device block
+        into the pager.  Returns ``(handle, tier)`` or ``None`` (pager
+        full → the caller falls back to true eviction)."""
+        sp = tracer.begin("paging/demote", block=int(node.block))
+        res = self.pager.put(self._read_kv_block(node.block))
+        if res is None:
+            tracer.end(sp, ok=False, full=True)
+            return None
+        handle, tier = res
+        tracer.end(sp, ok=True, handle=handle, tier=tier)
+        return handle, tier
+
+    def _promote_node(self, node) -> bool:
+        """Prefix-cache promote callback: fetch a demoted node's bytes
+        (staged by the promote-ahead thread when enabled) and scatter them
+        into a freshly-allocated device block.  The scatter is a host-side
+        ``.at[].set`` on the cache arrays — exactly ``import_prefix``'s
+        path — so the compiled prefill/decode programs never change."""
+        t0 = time.perf_counter()
+        sp = tracer.begin("paging/promote", handle=int(node.handle or -1),
+                          tier=node.tier)
+        arrays = self.pager.get(node.handle)
+        if arrays is None:
+            tracer.end(sp, ok=False, lost=True)
+            return False
+        alloc = self.kv.allocator
+        if alloc.free_blocks == 0:
+            # make room by demoting a colder node (walked-path ancestors
+            # are pinned by match(), so they are never victims)
+            self.prefix_cache.evict(1)
+        if alloc.free_blocks == 0:
+            tracer.end(sp, ok=False)
+            return False  # match stops here; the tail prefills normally
+        (dst,) = alloc.allocate(1)
+        dt = jnp.dtype(self.cfg.dtype)
+        self.caches = {
+            "k": self.caches["k"].at[:, dst].set(
+                jnp.asarray(arrays["k"]).astype(dt)),
+            "v": self.caches["v"].at[:, dst].set(
+                jnp.asarray(arrays["v"]).astype(dt)),
+        }
+        handle = node.handle
+        node.block = dst
+        node.tier = "device"
+        node.handle = None
+        self.pager.drop(handle)
+        alloc.note_promote()
+        wait_ms = (time.perf_counter() - t0) * 1e3
+        self.pager.record_promote_wait(wait_ms)
+        tracer.end(sp, ok=True, block=dst, wait_ms=wait_ms)
+        return True
+
+    def _prefetch_demoted(self, tokens: List[int]) -> None:
+        """Promote-ahead: walk the radix tree read-only along a just-queued
+        prompt and hand any demoted handles to the pager's background
+        thread, so the disk→host half of their promotion overlaps the
+        steps before this request is scheduled."""
+        node = self.prefix_cache._root
+        bs = self.cfg.block_size
+        handles: List[int] = []
+        matched = 0
+        while matched + bs <= len(tokens):
+            child = node.children.get(tuple(tokens[matched:matched + bs]))
+            if child is None:
+                break
+            if child.tier != "device" and child.handle is not None:
+                handles.append(child.handle)
+            node = child
+            matched += bs
+        if handles:
+            self.pager.prefetch(handles)
+
+    def close(self) -> None:
+        """Release paging resources (promote-ahead thread, spill writer).
+        Safe to call more than once; a pagerless engine is a no-op."""
+        if self.pager is not None:
+            self.pager.close()
+
     def spec_stats(self) -> Dict[str, float]:
         """Speculative-decoding counters for serving metrics; ``enabled=0``
         and all-zero when ``spec_mode`` is 'off'.  ``acceptance_rate`` is
@@ -785,6 +896,10 @@ class InferenceEngineV2:
                                  max_new_tokens=max_new_tokens,
                                  temperature=temperature, seed=seed)
         self.waiting.append(seq)
+        if self.pager is not None and self.cfg.kv_promote_ahead:
+            # overlap the disk→host half of any needed promotions with the
+            # steps that run before this request is scheduled
+            self._prefetch_demoted(seq.tokens)
         return self._uid
 
     def _schedule(self) -> List[Tuple[SequenceDescriptor, int]]:
@@ -884,6 +999,14 @@ class InferenceEngineV2:
             # seen_tokens == tokens actually written to KV)
             self.prefix_cache.donate(seq.tokens, seq.seen_tokens, seq.blocks)
             seq.blocks = []
+            if self.pager is not None:
+                # demote-on-pressure: keep one sequence's worth of headroom
+                # so the NEXT admission demotes nothing on its critical
+                # path (the donate above may have just consumed it)
+                short = (self.cfg.max_blocks_per_seq
+                         - self.kv.allocator.free_blocks)
+                if short > 0:
+                    self.prefix_cache.evict(short)
         else:
             self.kv.release(seq)
         del self.running[seq.uid]

@@ -1,0 +1,317 @@
+"""Host-DRAM paging tier for cold KV blocks (ZeRO-Infinity for inference).
+
+The serving analogue of the reference's offload layer (swap_tensor / aio /
+nvme, PAPER.md layer 7): under HBM pressure the prefix cache used to
+**evict** LRU radix leaves, so a returning session paid full recompute.
+With a :class:`BlockPager` attached, those leaves are **demoted** instead —
+their KV block bytes move to a bounded host-DRAM pool (tier "host"), and
+when that pool overflows, oldest-first to safetensors spill files on disk
+(tier "spill") written through ``io/fast_writer.py``'s FastPersist path.
+The radix tree keeps the node; a later match promotes the bytes back into
+a freshly-allocated device block instead of recomputing prefill.
+
+Tiering is exclusive: a block's bytes live in exactly one tier at a time
+(device OR host OR spill).  Promotion drops the paged copy; re-demotion
+re-serializes (a host-side memcpy — cheap next to the prefill it saves).
+
+Serialization is the engine's existing safetensors block layer
+(``build_safetensors_header`` — the same bytes ``export_prefix`` ships
+between replicas), so a host-pool entry IS a valid safetensors payload and
+the spill file IS a valid safetensors file.
+
+Threading (PR-17 ``named_lock`` discipline): all pool state lives under
+``named_lock("paging.pool")``; file IO — spill writes, spill reads, unlink
+— ALWAYS happens with no lock held (entries in transit are visible in a
+side map so readers never miss them).  The optional promote-ahead thread
+only moves bytes disk→host-staging; it never touches the device, the
+radix tree, or the allocator — those mutations stay on the engine thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...io.fast_writer import FastFileWriter, build_safetensors_header
+from ...utils.locks import named_lock
+
+
+def serialize_block(arrays: Dict[str, np.ndarray],
+                    metadata: Optional[Dict[str, str]] = None) -> bytes:
+    """One KV block as a safetensors payload (header + raw tensor bytes in
+    offset order) — byte-compatible with ``engine.export_prefix``."""
+    header, _offsets, _total = build_safetensors_header(arrays, metadata)
+    parts = [header]
+    for name in arrays:  # dict order == offset order
+        parts.append(np.ascontiguousarray(arrays[name]).tobytes())
+    return b"".join(parts)
+
+
+def deserialize_block(payload: bytes) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`serialize_block` (numpy views over the buffer)."""
+    import ml_dtypes
+
+    hlen = int.from_bytes(payload[:8], "little")
+    hdr = json.loads(payload[8:8 + hlen].decode())
+    data = payload[8 + hlen:]
+    hdr.pop("__metadata__", None)
+    dt_map = {"BF16": ml_dtypes.bfloat16, "F64": np.float64,
+              "F32": np.float32, "F16": np.float16,
+              "I64": np.int64, "I32": np.int32, "U8": np.uint8}
+    out: Dict[str, np.ndarray] = {}
+    for name, ent in hdr.items():
+        lo, hi = ent["data_offsets"]
+        out[name] = np.frombuffer(
+            data[lo:hi], dtype=dt_map[ent["dtype"]]).reshape(ent["shape"])
+    return out
+
+
+class BlockPager:
+    """Two-tier (host DRAM → optional disk spill) store of demoted KV
+    blocks, keyed by an opaque integer handle.
+
+    * :meth:`put` serializes a block's arrays into the host pool and
+      returns ``(handle, tier)``; when the pool is over ``host_bytes`` it
+      spills its OLDEST entries to ``spill_dir`` first, and returns
+      ``None`` only when neither tier has room (no spill dir) — the
+      caller then falls back to true eviction, so a full pager degrades
+      to exactly the old behaviour.
+    * :meth:`get` returns the block's arrays from whichever tier holds it
+      (staged prefetch → host → in-flight spill → disk).
+    * :meth:`prefetch` enqueues handles for the background thread to lift
+      disk entries into a host-side staging map ahead of the engine's
+      next scheduled step (the "async promote" half: the device scatter
+      itself stays on the engine thread).
+    * :meth:`drop` forgets a handle everywhere (called after a successful
+      promote, and by ``reset``).
+    """
+
+    def __init__(self, host_bytes: int, spill_dir: str = "",
+                 promote_ahead: bool = False):
+        self.host_bytes = int(host_bytes)
+        self.spill_dir = spill_dir
+        self._lock = named_lock("paging.pool")
+        self._next = 1
+        self._host: Dict[int, bytes] = {}      # handle -> payload (FIFO)
+        self._spilling: Dict[int, bytes] = {}  # write in flight, still readable
+        self._spill: Dict[int, str] = {}       # handle -> file path
+        self._staged: Dict[int, bytes] = {}    # prefetched from disk
+        self._host_used = 0
+        # counters (engine/serving metrics read these as monotonic)
+        self.demotions = 0
+        self.promotions = 0
+        self.spills = 0
+        self.promote_wait_total_ms = 0.0
+        self.promote_wait_samples: List[float] = []
+        self._writer: Optional[FastFileWriter] = None
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+            # modest geometry: one KV block per file, not a checkpoint
+            self._writer = FastFileWriter(block_size=1 << 20, queue_depth=8,
+                                          thread_count=2, fsync=False)
+        self._queue: "queue.Queue[Optional[int]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        if promote_ahead:
+            self._thread = threading.Thread(
+                target=self._prefetch_loop, name="kv-promote-ahead",
+                daemon=True)
+            self._thread.start()
+
+    # -- tier gauges (int reads; safe from any thread) -------------------
+
+    @property
+    def host_blocks(self) -> int:
+        return len(self._host) + len(self._spilling)
+
+    @property
+    def spill_blocks(self) -> int:
+        return len(self._spill) + len(self._staged)
+
+    @property
+    def resident_blocks(self) -> int:
+        """Blocks held by the pager across all its tiers."""
+        with self._lock:
+            return (len(self._host) + len(self._spilling)
+                    + len(self._spill) + len(self._staged))
+
+    def record_promote_wait(self, ms: float) -> None:
+        """Engine-reported end-to-end promote latency (fetch + device
+        scatter) — the SLO-facing number."""
+        with self._lock:
+            self.promote_wait_total_ms += ms
+            self.promote_wait_samples.append(ms)
+            if len(self.promote_wait_samples) > 4096:
+                del self.promote_wait_samples[:2048]
+
+    # -- demote ----------------------------------------------------------
+
+    def put(self, arrays: Dict[str, np.ndarray],
+            metadata: Optional[Dict[str, str]] = None
+            ) -> Optional[Tuple[int, str]]:
+        """Adopt a demoted block.  Returns ``(handle, tier)``, or ``None``
+        when full (caller falls back to eviction)."""
+        payload = serialize_block(arrays, metadata)  # pure CPU, no lock
+        spill_work: List[Tuple[int, bytes]] = []
+        with self._lock:
+            if self._closed:
+                return None
+            projected = self._host_used + len(payload)
+            if projected > self.host_bytes and self._writer is None:
+                # no spill tier to push the overflow into; anything the
+                # pager silently forgot would be a lost block, so refuse —
+                # the caller degrades to plain eviction
+                return None
+            handle = self._next
+            self._next += 1
+            self._host[handle] = payload
+            self._host_used += len(payload)
+            tier = "host"
+            while self._host_used > self.host_bytes and self._host:
+                old, buf = next(iter(self._host.items()))
+                del self._host[old]
+                self._host_used -= len(buf)
+                self._spilling[old] = buf
+                spill_work.append((old, buf))
+            if handle not in self._host:  # the new entry itself spilled
+                tier = "spill"
+        for old, buf in spill_work:  # file IO with no lock held
+            self._write_spill(old, buf)
+        with self._lock:
+            self.demotions += 1
+        return handle, tier
+
+    def _spill_path(self, handle: int) -> str:
+        return os.path.join(self.spill_dir, f"kvblock-{handle}.safetensors")
+
+    def _write_spill(self, handle: int, payload: bytes) -> None:
+        path = self._spill_path(handle)
+        arrays = deserialize_block(payload)
+        assert self._writer is not None
+        self._writer.write_safetensors(arrays, path)
+        with self._lock:
+            if handle in self._spilling:  # not dropped mid-write
+                del self._spilling[handle]
+                self._spill[handle] = path
+                self.spills += 1
+            else:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    # -- promote ---------------------------------------------------------
+
+    def get(self, handle: int) -> Optional[Dict[str, np.ndarray]]:
+        """The block's arrays, from whichever tier holds it; ``None`` for
+        an unknown handle.  Does NOT drop the entry — callers drop only
+        after the device scatter succeeded, so a failed promote (no free
+        device block) loses nothing."""
+        with self._lock:
+            buf = (self._staged.get(handle) or self._host.get(handle)
+                   or self._spilling.get(handle))
+            path = None if buf is not None else self._spill.get(handle)
+        if buf is not None:
+            arrays = deserialize_block(buf)
+        elif path is not None:
+            try:
+                with open(path, "rb") as f:  # IO with no lock held
+                    data = f.read()
+            except OSError:
+                return None
+            arrays = deserialize_block(data)
+        else:
+            return None
+        with self._lock:
+            self.promotions += 1
+        return arrays
+
+    def drop(self, handle: int) -> None:
+        """Forget a handle everywhere (post-promote, or tree reset)."""
+        with self._lock:
+            buf = self._host.pop(handle, None)
+            if buf is not None:
+                self._host_used -= len(buf)
+            self._staged.pop(handle, None)
+            # an entry mid-spill is dropped by the writer when it notices
+            self._spilling.pop(handle, None)
+            path = self._spill.pop(handle, None)
+        if path is not None:
+            try:
+                os.unlink(path)  # IO with no lock held
+            except OSError:
+                pass
+
+    # -- promote-ahead (background, host-side only) ----------------------
+
+    def prefetch(self, handles: List[int]) -> None:
+        """Ask the background thread to lift spill entries into the staging
+        map so the engine's synchronous :meth:`get` finds them in DRAM.
+        No-op without a promote-ahead thread, or for host-tier handles."""
+        if self._thread is None:
+            return
+        for h in handles:
+            self._queue.put(h)
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            handle = self._queue.get()  # blocking wait holds NO lock
+            if handle is None:
+                return
+            with self._lock:
+                if (self._closed or handle in self._staged
+                        or handle in self._host or handle in self._spilling):
+                    continue
+                path = self._spill.get(handle)
+            if path is None:
+                continue
+            try:
+                with open(path, "rb") as f:  # IO with no lock held
+                    data = f.read()
+            except OSError:
+                continue
+            with self._lock:
+                if handle in self._spill:  # not dropped during the read
+                    self._staged[handle] = data
+
+    # -- lifecycle -------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "tier_host_blocks": len(self._host) + len(self._spilling),
+                "tier_spill_blocks": len(self._spill) + len(self._staged),
+                "demotions": self.demotions,
+                "promotions": self.promotions,
+                "spills": self.spills,
+                "promote_wait_ms": self.promote_wait_total_ms,
+                "host_bytes_used": self._host_used,
+            }
+
+    def promote_wait_percentiles(self) -> Dict[str, float]:
+        with self._lock:
+            samples = sorted(self.promote_wait_samples)
+        if not samples:
+            return {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+        def pct(p: float) -> float:
+            i = min(len(samples) - 1, int(round(p * (len(samples) - 1))))
+            return samples[i]
+        return {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99)}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

@@ -19,6 +19,17 @@ Ownership model (see ``BlockedAllocator`` refcounts):
 - ``evict`` removes LRU *leaves* whose block has no owner besides the
   tree, returning those blocks to the pool.
 
+Memory hierarchy (``inference/v2/paging.py``): with a block pager
+attached, ``evict`` *demotes* instead — the victim's KV bytes move to the
+host tier, its device block returns to the pool, and the NODE STAYS IN
+THE TREE with ``tier != "device"`` and a pager handle.  A later ``match``
+that reaches a demoted node promotes it back into a fresh device block
+(engine callback) instead of recomputing prefill.  Invariant: a
+non-device node never has a device descendant — demotion picks nodes
+whose children are all demoted already (so whole subtrees go cold
+together), and ``donate`` re-adopts a demoted node on its path by giving
+it the sequence's own (identical) device block.
+
 All mutation happens on the engine thread (the serving broker serializes
 every engine call); gauge reads from other threads only touch ints.
 """
@@ -57,11 +68,16 @@ def prefix_digests(tokens: Sequence[int], block_size: int,
 @dataclasses.dataclass(eq=False)
 class _Node:
     chunk: Tuple[int, ...]  # edge label from parent: block_size token ids
-    block: int  # KV block holding this chunk's keys/values
+    block: int  # KV block holding this chunk's keys/values (-1 if demoted)
     parent: Optional["_Node"]
     children: Dict[Tuple[int, ...], "_Node"] = dataclasses.field(
         default_factory=dict)
     last_used: int = 0
+    #: which memory tier holds this chunk's KV bytes: "device" (block is a
+    #: live pool id), "host" or "spill" (block is -1, ``handle`` names the
+    #: pager entry).  Anything != "device" is paged out.
+    tier: str = "device"
+    handle: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -103,6 +119,21 @@ class PrefixCache:
         self.tokens_skipped = 0
         self.evictions = 0
         self.cow_copies = 0
+        # memory hierarchy (attach_pager): demote/promote are engine
+        # callbacks because only the engine can read/scatter device KV
+        self.pager = None
+        self._demote_cb = None   # _Node -> Optional[(handle, tier)]
+        self._promote_cb = None  # _Node -> bool (True: node is device again)
+
+    def attach_pager(self, pager, demote_cb, promote_cb) -> None:
+        """Enable demote-instead-of-evict (``inference/v2/paging.py``).
+        ``demote_cb(node)`` serializes the node's device block into the
+        pager and returns ``(handle, tier)`` or ``None`` when the pager is
+        full; ``promote_cb(node)`` fetches a demoted node's bytes back into
+        a fresh device block and returns success."""
+        self.pager = pager
+        self._demote_cb = demote_cb
+        self._promote_cb = promote_cb
 
     # -- lookup --------------------------------------------------------
 
@@ -127,8 +158,20 @@ class PrefixCache:
             child = node.children.get(tuple(tokens[matched:matched + bs]))
             if child is None:
                 break
+            if child.tier != "device":
+                # demoted prefix: a miss becomes a host→device promote
+                # instead of a recompute.  On failure (pager entry gone,
+                # or no device block even after demoting others) the walk
+                # stops here and the tail prefills normally.
+                if self._promote_cb is None or not self._promote_cb(child):
+                    break
             node = child
             node.last_used = self._clock
+            # pin the walked path immediately (not at the end): promoting
+            # a deeper node may demote-to-make-room, and an unpinned
+            # ancestor on this very path would be a legal victim — its
+            # freed block id would go stale in ``blocks``
+            self.allocator.incref(node.block)
             blocks.append(node.block)
             matched += bs
         # partial-block divergence: find the child sharing the longest
@@ -139,6 +182,8 @@ class PrefixCache:
         if room > 0:
             rest = tuple(tokens[matched:matched + room])
             for chunk, child in node.children.items():
+                if child.tier != "device":
+                    continue  # COW forks read device bytes only
                 m = 0
                 while m < room and chunk[m] == rest[m]:
                     m += 1
@@ -150,9 +195,9 @@ class PrefixCache:
                     child.last_used = self._clock
         total = matched + cow_tokens
         if total == 0 or total < self.min_prefix_tokens:
+            if blocks:
+                self.allocator.free(blocks)  # drop the walk's pins
             return None
-        for b in blocks:
-            self.allocator.incref(b)
         if cow_src is not None:
             self.allocator.incref(cow_src)
         return PrefixMatch(blocks=blocks, tokens=matched, cow_src=cow_src,
@@ -171,8 +216,8 @@ class PrefixCache:
         matched = 0
         while matched + bs <= len(tokens):
             child = node.children.get(tuple(tokens[matched:matched + bs]))
-            if child is None:
-                break
+            if child is None or child.tier != "device":
+                break  # exports ship device bytes; demoted tails stay put
             node = child
             blocks.append(node.block)
             matched += bs
@@ -210,8 +255,12 @@ class PrefixCache:
         only full blocks are cacheable.  For each full chunk: if the tree
         already has it, the sequence's reference is dropped (the shared
         block was the same one, or a duplicate we don't need); otherwise
-        the node adopts the sequence's reference.  Trailing partial /
-        unused blocks go back to the pool.
+        the node adopts the sequence's reference.  A *demoted* node on the
+        path is re-adopted instead: the sequence's device block holds the
+        identical KV bytes, so the node takes it, goes back to tier
+        "device", and the paged copy is dropped — promotion for free,
+        preserving the no-device-under-paged subtree invariant.  Trailing
+        partial / unused blocks go back to the pool.
         """
         self._clock += 1
         bs = self.block_size
@@ -224,6 +273,13 @@ class PrefixCache:
                 child = _Node(chunk=chunk, block=blocks[i], parent=node)
                 node.children[chunk] = child
                 self._nodes.append(child)
+            elif child.tier != "device":
+                child.block = blocks[i]  # adopt the sequence's reference
+                child.tier = "device"
+                if self.pager is not None and child.handle is not None:
+                    self.pager.drop(child.handle)
+                child.handle = None
+                self.allocator.note_promote()
             else:
                 self.allocator.free([blocks[i]])
             child.last_used = self._clock
@@ -234,36 +290,91 @@ class PrefixCache:
     # -- eviction ------------------------------------------------------
 
     def evict(self, n: int) -> int:
-        """Free up to ``n`` blocks by removing LRU leaves whose block is
-        referenced only by the tree.  Returns blocks actually freed."""
-        if self.eviction != "lru":
+        """Free up to ``n`` device blocks, preferring *demotion* (pager
+        attached: bytes to host tier, node stays in the tree) over true
+        eviction.  Returns device blocks actually freed.
+
+        Candidates are LRU device nodes whose block is referenced only by
+        the tree and whose children (if any) are all paged out already —
+        so subtrees demote root-last and "demoted subtrees" survive whole.
+        Under ``eviction="none"`` a pager still demotes (lossless), but
+        nothing is ever truly evicted.
+
+        Nodes aliased to ONE block (a COW fork can leave two leaf paths on
+        the same block id, each holding its own tree reference) are
+        handled as a group: every alias node is detached and drops its
+        reference, but the group counts as ONE freed block — the old code
+        treated each alias as an independent victim, double-counting the
+        block in pressure math and in the freed total."""
+        if self.eviction != "lru" and self.pager is None:
             return 0
         freed = 0
+        skipped: set = set()
         while freed < n:
+            owners: Dict[int, List[_Node]] = {}
+            for nd in self._nodes:
+                if nd.tier == "device":
+                    owners.setdefault(nd.block, []).append(nd)
             victim: Optional[_Node] = None
             for node in self._nodes:
-                if node.children:
+                if node.tier != "device" or id(node) in skipped:
                     continue
-                if self.allocator.refcount(node.block) != 1:
+                if any(c.tier == "device" for c in node.children.values()):
+                    continue  # demote leaves-first (device-wise)
+                # each alias node holds its own tree reference: the block
+                # is tree-only iff refcount == number of owning nodes
+                if self.allocator.refcount(node.block) != \
+                        len(owners[node.block]):
                     continue  # pinned by a live sequence
                 if victim is None or node.last_used < victim.last_used:
                     victim = node
             if victim is None:
                 break
-            del victim.parent.children[victim.chunk]
-            self._nodes.remove(victim)
-            self.allocator.free([victim.block])
+            aliases = [a for a in owners[victim.block] if a is not victim]
+            if self._demote_cb is not None and not aliases:
+                res = self._demote_cb(victim)
+                if res is not None:
+                    handle, tier = res
+                    self.allocator.free([victim.block])
+                    self.allocator.note_demote()
+                    victim.block = -1
+                    victim.tier = tier
+                    victim.handle = handle
+                    freed += 1
+                    continue
+            if self.eviction != "lru" or victim.children:
+                # pager full (or eviction disabled): a node over a demoted
+                # subtree must never be truly evicted — that would orphan
+                # the subtree — so it is simply not reclaimable right now
+                skipped.add(id(victim))
+                continue
+            group = [victim] + aliases
+            if any(a.children for a in aliases):
+                skipped.update(id(a) for a in group)
+                continue
+            for nd in group:
+                del nd.parent.children[nd.chunk]
+                self._nodes.remove(nd)
+                self.allocator.free([nd.block])  # one tree ref per node
             self.evictions += 1
-            freed += 1
+            freed += 1  # ONE device block returned to the pool
         return freed
 
     def reset(self) -> int:
         """Drop the whole tree, freeing every block no sequence shares.
         Blocks still referenced by live sequences lose only the tree's
-        reference.  Returns the number of nodes dropped."""
+        reference; demoted nodes drop their pager entries.  Returns the
+        number of nodes dropped."""
         dropped = len(self._nodes)
         for node in self._nodes:
-            self.allocator.free([node.block])
+            if node.tier != "device":
+                if self.pager is not None and node.handle is not None:
+                    self.pager.drop(node.handle)
+                self.allocator.note_promote()
+            else:
+                # every node holds exactly one reference — alias nodes
+                # (two paths on one block) each drop their own
+                self.allocator.free([node.block])
         self._nodes = []
         self._root.children = {}
         return dropped
@@ -275,28 +386,95 @@ class PrefixCache:
         return len(self._nodes)
 
     @property
+    def device_blocks(self) -> int:
+        """Distinct device blocks the tree holds (alias nodes deduped)."""
+        return len({nd.block for nd in self._nodes if nd.tier == "device"})
+
+    @property
+    def demoted_blocks(self) -> int:
+        """Tree nodes whose KV bytes live in the pager (host or spill)."""
+        return sum(1 for nd in self._nodes if nd.tier != "device")
+
+    def _device_owners(self) -> Dict[int, int]:
+        """block id -> number of device-tier tree nodes owning it (alias
+        nodes from a COW fork can put two nodes on one block; each holds
+        its own reference)."""
+        owners: Dict[int, int] = {}
+        for nd in self._nodes:
+            if nd.tier == "device":
+                owners[nd.block] = owners.get(nd.block, 0) + 1
+        return owners
+
+    @property
     def evictable_blocks(self) -> int:
-        """Tree blocks held ONLY by the tree (refcount 1) — reclaimable
-        under pressure when the policy allows eviction."""
-        return sum(1 for nd in self._nodes
-                   if self.allocator.refcount(nd.block) == 1)
+        """DISTINCT device blocks held only by the tree — reclaimable
+        under pressure.  Deduped by block id: the old per-node count
+        listed a COW-fork-aliased block twice, overstating reclaimable
+        capacity in admission/pressure math."""
+        return sum(1 for b, k in self._device_owners().items()
+                   if self.allocator.refcount(b) == k)
 
     @property
     def shared_blocks(self) -> int:
-        """Tree blocks also referenced by at least one live sequence."""
-        return sum(1 for nd in self._nodes
-                   if self.allocator.refcount(nd.block) >= 2)
+        """Distinct tree blocks also referenced by a live sequence."""
+        return sum(1 for b, k in self._device_owners().items()
+                   if self.allocator.refcount(b) > k)
 
     @property
     def reclaimable_blocks(self) -> int:
-        """What admission control may count as effectively-free."""
-        return self.evictable_blocks if self.eviction == "lru" else 0
+        """What admission control may count as effectively-free.  A pager
+        makes cached blocks recoverable even under ``eviction="none"``:
+        demotion is lossless, so pressure can always push them out."""
+        if self.eviction == "lru" or self.pager is not None:
+            return self.evictable_blocks
+        return 0
 
     @property
     def hit_rate(self) -> float:
         return self.hits / self.lookups if self.lookups else 0.0
 
+    def check_consistency(self) -> None:
+        """Tier invariants on top of the allocator's pool check:
+        ``device_free + evictable + pinned + demoted == total + demoted``
+        (i.e. the resident KV footprint exactly partitions into tiers),
+        with ``demoted`` verified three ways — allocator counter, pager
+        residency, and tree nodes — so none of the terms is vacuous."""
+        self.allocator.check_consistency()
+        demoted_nodes = self.demoted_blocks
+        if self.allocator.demoted != demoted_nodes:
+            raise AssertionError(
+                f"allocator says {self.allocator.demoted} demoted blocks, "
+                f"tree holds {demoted_nodes} non-device nodes")
+        if self.pager is not None:
+            resident = self.pager.resident_blocks
+            if resident != demoted_nodes:
+                raise AssertionError(
+                    f"pager holds {resident} blocks, tree references "
+                    f"{demoted_nodes} demoted nodes")
+            for nd in self._nodes:
+                if nd.tier != "device" and nd.handle is None:
+                    raise AssertionError("demoted node without a handle")
+        for nd in self._nodes:
+            if nd.tier != "device":
+                if any(c.tier == "device" for c in nd.children.values()):
+                    raise AssertionError(
+                        "device node under a demoted parent")
+            elif nd.block < 0:
+                raise AssertionError("device node with block -1")
+        alloc = self.allocator
+        live = sum(1 for b in range(alloc.num_blocks) if alloc.refcount(b) > 0)
+        pinned = live - self.evictable_blocks
+        lhs = alloc.free_blocks + self.evictable_blocks + pinned \
+            + alloc.demoted
+        if lhs != alloc.num_blocks + alloc.demoted:
+            raise AssertionError(
+                f"tier accounting broken: {alloc.free_blocks} free + "
+                f"{self.evictable_blocks} evictable + {pinned} pinned + "
+                f"{alloc.demoted} demoted != "
+                f"{alloc.num_blocks} + {alloc.demoted}")
+
     def stats(self) -> Dict[str, float]:
+        pg = self.pager
         return {
             "lookups": self.lookups,
             "hits": self.hits,
@@ -307,4 +485,11 @@ class PrefixCache:
             "cached_blocks": self.cached_blocks,
             "shared_blocks": self.shared_blocks,
             "evictable_blocks": self.evictable_blocks,
+            # memory-hierarchy gauges (all zero without a pager)
+            "tier_device_blocks": self.device_blocks,
+            "tier_host_blocks": pg.host_blocks if pg else 0,
+            "tier_spill_blocks": pg.spill_blocks if pg else 0,
+            "demotions": pg.demotions if pg else 0,
+            "promotions": pg.promotions if pg else 0,
+            "promote_wait_ms": pg.promote_wait_total_ms if pg else 0.0,
         }

@@ -40,6 +40,23 @@ class BlockedAllocator:
         self._free: List[int] = list(range(num_blocks))
         self._refs: List[int] = [0] * num_blocks
         self.num_blocks = num_blocks
+        #: blocks whose bytes were demoted off-device by the paging tier
+        #: (``inference/v2/paging.py``) — they hold no pool id, but they
+        #: are part of the resident KV footprint, so the consistency check
+        #: extends to ``free + evictable + pinned + demoted == total +
+        #: demoted`` (see ``PrefixCache.check_consistency``)
+        self.demoted = 0
+
+    def note_demote(self) -> None:
+        """A device block's bytes moved to the host/spill tier (the block
+        id itself was freed separately)."""
+        self.demoted += 1
+
+    def note_promote(self) -> None:
+        """A demoted block's bytes came back on-device (or were dropped)."""
+        if self.demoted <= 0:
+            raise AssertionError("promote with no demoted blocks tracked")
+        self.demoted -= 1
 
     @property
     def free_blocks(self) -> int:
@@ -94,6 +111,8 @@ class BlockedAllocator:
             raise AssertionError(
                 f"pool accounting broken: {live} live + "
                 f"{len(self._free)} free != {self.num_blocks} total")
+        if self.demoted < 0:
+            raise AssertionError(f"negative demoted count {self.demoted}")
 
 
 @dataclasses.dataclass

@@ -350,7 +350,9 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
                         deadline_s: Optional[float] = None,
                         tenants: int = 0,
                         sampled_fraction: float = 0.0,
-                        sampled_temperature: float = 0.7
+                        sampled_temperature: float = 0.7,
+                        resume_fraction: float = 0.0,
+                        idle_gap_s: float = 0.0
                         ) -> Tuple[Dict[str, Any], List[WorkloadRequest]]:
     """Seeded synthetic workload with production-shaped structure:
 
@@ -366,7 +368,14 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
       (per-tenant goodput accounting needs labeled traffic);
     * optional **per-request sampling** — a seeded ``sampled_fraction``
       of requests carries ``sampled_temperature`` while the rest stays
-      greedy, so one batch mixes both lanes of the per-row sampler.
+      greedy, so one batch mixes both lanes of the per-row sampler;
+    * optional **session idle/resume** — ``resume_fraction`` appends a
+      second wave of requests, each re-issuing an earlier request's full
+      prompt (plus a fresh suffix) after an ``idle_gap_s`` quiet period.
+      This is the memory-pressure shape the paging tier exists for: the
+      first wave's prefixes go cold during the gap (demoted under
+      pressure), and the resume wave's hit rate measures whether
+      demote-instead-of-evict kept those sessions resident.
 
     Deterministic: same arguments → identical workload.
     """
@@ -409,6 +418,29 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
             temperature=(float(sampled_temperature)
                          if sampled_mask[i] else None),
             tenant=(f"tenant{int(tenant_picks[i])}" if tenants else None)))
+    # session idle/resume wave (all extra rng draws happen AFTER the base
+    # wave's, so resume_fraction=0.0 reproduces historical workloads
+    # byte-identically)
+    num_resumes = int(round(resume_fraction * num_requests))
+    if num_resumes > 0:
+        last = float(offsets[-1])
+        rgaps = rng.gamma(gamma_shape, 1.0 / (mean_rate_rps * gamma_shape),
+                          size=num_resumes)
+        roffsets = last + idle_gap_s + np.cumsum(rgaps)
+        parents = rng.integers(0, num_requests, size=num_resumes)
+        rbudgets = np.minimum(
+            max_new_tokens,
+            1 + rng.geometric(min(1.0, 2.0 / max(2, max_new_tokens)),
+                              size=num_resumes))
+        for j in range(num_resumes):
+            parent = requests[int(parents[j])]
+            suffix = rng.integers(1, vocab + 1, size=suffix_len)
+            requests.append(WorkloadRequest(
+                offset_s=float(roffsets[j]),
+                prompt=list(parent.prompt) + [int(t) for t in suffix],
+                max_new_tokens=int(rbudgets[j]),
+                deadline_s=deadline_s,
+                template=parent.template))
     meta = {"source": "synthetic", "seed": seed,
             "requests": num_requests, "mean_rate_rps": mean_rate_rps,
             "gamma_shape": gamma_shape, "num_templates": num_templates,
@@ -416,7 +448,8 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
             "zipf_a": zipf_a, "vocab": vocab,
             "max_new_tokens": max_new_tokens,
             "cancel_fraction": cancel_fraction, "tenants": tenants,
-            "sampled_fraction": sampled_fraction}
+            "sampled_fraction": sampled_fraction,
+            "resume_fraction": resume_fraction, "idle_gap_s": idle_gap_s}
     return meta, requests
 
 
@@ -691,6 +724,12 @@ _SLO_KEYS = {
     "min_goodput_rps", "min_tokens_per_s",
     "min_completed_fraction", "max_failed", "max_rejected",
     "max_queue_depth_p95", "max_queue_depth_max",
+    # memory-pressure paging scenario (bench --mode replay --paging):
+    # resume-wave hit rate with the pager on, its gain over the evict-only
+    # baseline on the identical seeded workload, sessions still resident
+    # across the idle gap, promote latency, and the leak gate
+    "min_hit_rate_under_pressure", "min_hit_rate_gain",
+    "min_sessions_resident", "max_promote_ms_p95", "max_leaked_blocks",
 }
 
 

@@ -647,6 +647,228 @@ def run_replay(workload_trace: Optional[str] = None, seed: int = 0,
     return result
 
 
+# -- serving memory hierarchy: paging under memory pressure (ISSUE 18) -----
+
+
+def run_paging_replay(seed: int = 0, requests: int = 24,
+                      rate_rps: float = 8.0,
+                      resume_fraction: float = 0.5,
+                      idle_gap_s: float = 0.5,
+                      time_scale: float = 1.0,
+                      slo_path: Optional[str] = None,
+                      slo_workload: str = "paging-smoke",
+                      model: str = "tiny", max_queue: int = 64,
+                      num_blocks: int = 28,
+                      kv_host_pool_mb: int = 8,
+                      kv_spill_dir: str = "",
+                      kv_promote_ahead: bool = True) -> dict:
+    """Memory-pressure A/B for the host-DRAM paging tier (``--paging``).
+
+    One seeded session-idle/resume workload (``synthesize_workload`` with
+    ``resume_fraction``: a base wave of sessions, a quiet gap, then a
+    resume wave re-issuing earlier sessions' full prompts) replayed twice
+    against a deliberately tiny device pool — once with the pager on
+    (cold blocks demote to host DRAM / spill), once evict-only.  The
+    device pool is sized well below the base wave's working set, so the
+    baseline MUST forget sessions while the pager may not.
+
+    Geometry is chosen so each session's prompt (template 20 + suffix 4
+    tokens, block size 8) fills exactly 3 blocks: blocks 1-2 are the
+    shared template head (hot in both legs), block 3 is unique per
+    session (the cold tail the pager exists to keep).  Hit rate is
+    therefore measured in TOKENS — resume-wave ``prefill_tokens_skipped``
+    over resume-wave prompt tokens — because block-granular binary hits
+    cannot distinguish "matched the shared template" from "matched the
+    whole session".
+
+    Records ``hit_rate_under_pressure`` (paging leg), ``hit_rate_gain``
+    (paging − evict-only, the strictly-positive tentpole gate),
+    ``sessions_resident`` (sessions' worth of KV blocks still held across
+    all tiers at the idle point), promote-latency percentiles, leak
+    counts, and a decode-HLO identity bit (paging is host-side only: the
+    compiled step programs must be byte-identical on/off) — gated by the
+    ``paging-smoke`` table in slo.toml.
+    """
+    import argparse
+    import dataclasses as _dc
+
+    from ..observability import replay as rp
+    from .balancer import ReplicaPool
+    from .config import ServingConfig
+    from .server import (add_engine_cli_args, add_serving_cli_args,
+                         build_engine_factory)
+
+    template_len, suffix_len, block_size = 20, 4, 8
+    blocks_per_session = (template_len + suffix_len) // block_size
+    meta, wl = rp.synthesize_workload(seed=seed, num_requests=requests,
+                                      mean_rate_rps=rate_rps,
+                                      num_templates=6,
+                                      template_len=template_len,
+                                      suffix_len=suffix_len,
+                                      max_new_tokens=8,
+                                      resume_fraction=resume_fraction,
+                                      idle_gap_s=idle_gap_s)
+    base, resume = wl[:requests], wl[requests:]
+    if not resume:
+        raise rp.WorkloadError("resume_fraction produced no resume wave")
+    # the waves replay back to back with an explicit drain between them
+    # (that drain IS the idle gap), so rebase the resume offsets to zero
+    t_first = resume[0].offset_s
+    resume = [_dc.replace(r, offset_s=r.offset_s - t_first) for r in resume]
+    resume_prompt_tokens = sum(len(r.prompt) for r in resume)
+    slos = rp.load_slos(slo_path)
+    if slo_workload not in slos:
+        raise rp.SLOError(f"no [workloads.\"{slo_workload}\"] table in "
+                          f"{slo_path or rp.default_slo_path()}; have "
+                          f"{sorted(slos)}")
+
+    def eargs_for(paging: bool):
+        argv = ["--model", model, "--seed", "0",
+                "--num_blocks", str(num_blocks),
+                "--max_tokens_per_step", "32", "--max_seqs", "4",
+                "--block_size", str(block_size),
+                "--max_blocks_per_seq", "8",
+                "--max_queue", str(max_queue), "--enable_prefix_cache"]
+        if paging:
+            argv += ["--kv_host_pool_mb", str(kv_host_pool_mb)]
+            if kv_spill_dir:
+                argv += ["--kv_spill_dir", kv_spill_dir]
+            if kv_promote_ahead:
+                argv.append("--kv_promote_ahead")
+        ep = argparse.ArgumentParser()
+        add_engine_cli_args(ep)
+        add_serving_cli_args(ep)
+        return ep.parse_args(argv)
+
+    def _wait_idle(pool, budget_s: float = 60.0) -> None:
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            if sum(t.num_running() for t in pool.replicas
+                   if t.healthy()) == 0 and pool.queue_depth() == 0:
+                return
+            time.sleep(0.2)
+
+    def one_leg(paging: bool) -> dict:
+        # ONE replica: the A/B contrasts one engine's memory hierarchy,
+        # not routing — splitting the waves over replicas would dilute
+        # the pressure and make hits depend on the router
+        cfg = ServingConfig(max_queue=max_queue, num_replicas=1,
+                            replica_transport="inprocess",
+                            submit_timeout_s=120.0)
+        pool = ReplicaPool.build(build_engine_factory(eargs_for(paging)),
+                                 cfg)
+        pool.start()
+        pool.wait_ready()
+        try:
+            pool.submit([1, 2, 3], max_new_tokens=2).result(timeout=300)
+            out_base = rp.replay_workload(pool, base,
+                                          time_scale=time_scale)
+            _wait_idle(pool)
+            s0 = pool.replicas[0].prefix_stats()
+            resident = int(s0.get("tier_device_blocks", 0)
+                           + s0.get("tier_host_blocks", 0)
+                           + s0.get("tier_spill_blocks", 0))
+            out_resume = rp.replay_workload(pool, resume,
+                                            time_scale=time_scale)
+            _wait_idle(pool)
+            s1 = pool.replicas[0].prefix_stats()
+            eng = pool.replicas[0].broker.engine
+            if eng.prefix_cache is not None:
+                eng.prefix_cache.check_consistency()
+            promote_ms = (eng.pager.promote_wait_percentiles()
+                          if eng.pager is not None
+                          else {"p50": 0.0, "p95": 0.0, "p99": 0.0})
+            pager_stats = (eng.pager.stats()
+                           if eng.pager is not None else None)
+            leaked = int(s1.get("pinned_blocks", 0))
+        finally:
+            pool.drain()
+        recs = out_base["requests"] + out_resume["requests"]
+        wall = (out_base["summary"]["wall_s"]
+                + out_resume["summary"]["wall_s"])
+        skipped = s1.get("prefill_tokens_skipped", 0) \
+            - s0.get("prefill_tokens_skipped", 0)
+        return {
+            "summary": rp.summarize_replay(recs, [], wall),
+            "resume_hit_token_rate": round(
+                float(skipped) / max(1, resume_prompt_tokens), 6),
+            "resume_tokens_skipped": int(skipped),
+            "sessions_resident_at_idle": resident // blocks_per_session,
+            "promote_ms": promote_ms,
+            "pager": pager_stats,
+            "leaked_blocks": leaked,
+            "demotions": int(s1.get("demotions", 0)),
+            "promotions": int(s1.get("promotions", 0)),
+        }
+
+    def _decode_hlo(paging: bool) -> str:
+        # the identity half of the acceptance bar: paging is entirely
+        # host-side bookkeeping, so the compiled decode step must not
+        # know it exists (same idiom as tests/test_paging.py)
+        import jax
+        import numpy as np
+
+        eng = build_engine_factory(eargs_for(paging))()
+        seqs = eng.cfg.max_seqs
+        toks = np.zeros((seqs,), np.int32)
+        pos = np.zeros((seqs,), np.int32)
+        tables = np.zeros((seqs, eng.cfg.max_blocks_per_seq), np.int32)
+        ctx = np.ones((seqs,), np.int32)
+        temps = np.zeros((seqs,), np.float32)
+        seeds = np.zeros((seqs,), np.int32)
+        txt = eng._decode_fwd.lower(eng.params, eng.caches, toks, pos,
+                                    tables, ctx, temps,
+                                    jax.random.PRNGKey(0),
+                                    seeds).as_text()
+        eng.close()
+        return txt
+
+    paging_leg = one_leg(True)
+    evict_leg = one_leg(False)
+    hlo_identical = _decode_hlo(True) == _decode_hlo(False)
+
+    summary = dict(paging_leg["summary"])
+    summary["hit_rate_under_pressure"] = paging_leg["resume_hit_token_rate"]
+    summary["hit_rate_gain"] = round(
+        paging_leg["resume_hit_token_rate"]
+        - evict_leg["resume_hit_token_rate"], 6)
+    summary["sessions_resident"] = paging_leg["sessions_resident_at_idle"]
+    summary["promote_ms_p95"] = paging_leg["promote_ms"]["p95"]
+    summary["leaked_blocks"] = (paging_leg["leaked_blocks"]
+                                + evict_leg["leaked_blocks"])
+    violations = rp.check_slo(summary, slos[slo_workload], slo_workload)
+    if not hlo_identical:
+        violations = list(violations) + [rp.SLOViolation(
+            slo_workload, "decode_hlo_identical", True, False)]
+    return {
+        "subject": f"{model} model, JAX_PLATFORMS=cpu, session idle/resume "
+                   f"replay, {num_blocks}-block device pool (~"
+                   f"{num_blocks // blocks_per_session} sessions) vs "
+                   f"{requests} base sessions — paging "
+                   f"(host {kv_host_pool_mb} MiB"
+                   + (f", spill {kv_spill_dir}" if kv_spill_dir else "")
+                   + ") A/B evict-only on the identical seeded workload",
+        "workload_meta": meta,
+        "time_scale": time_scale,
+        "slo_workload": slo_workload,
+        "summary": summary,
+        "hit_rate_under_pressure": summary["hit_rate_under_pressure"],
+        "hit_rate_evict_only": evict_leg["resume_hit_token_rate"],
+        "hit_rate_gain": summary["hit_rate_gain"],
+        "sessions_resident": summary["sessions_resident"],
+        "sessions_resident_evict_only":
+            evict_leg["sessions_resident_at_idle"],
+        "promote_ms": paging_leg["promote_ms"],
+        "pager": paging_leg["pager"],
+        "demotions": paging_leg["demotions"],
+        "promotions": paging_leg["promotions"],
+        "decode_hlo_identical": hlo_identical,
+        "evict_only_summary": evict_leg["summary"],
+        "leaked_blocks_after_idle": summary["leaked_blocks"],
+        "slo_violations": [v.to_dict() for v in violations],
+    }
+
+
 # -- mixed-GEMM kernel microbench ------------------------------------------
 
 
@@ -810,10 +1032,38 @@ def main(argv=None) -> int:
                    help="replay: synthesized prompt-template length")
     p.add_argument("--max_new_tokens", type=int, default=8,
                    help="replay: synthesized generation-budget cap")
+    p.add_argument("--paging", action="store_true",
+                   help="replay: memory-pressure session-resume A/B for "
+                        "the host-DRAM paging tier (tiny device pool; "
+                        "paging vs evict-only on the identical seeded "
+                        "workload, gated by the paging-smoke SLO table)")
+    p.add_argument("--resume_fraction", type=float, default=0.5,
+                   help="replay --paging: resume-wave size as a fraction "
+                        "of the base wave")
+    p.add_argument("--idle_gap_s", type=float, default=0.5,
+                   help="replay --paging: quiet period between the base "
+                        "and resume waves")
+    p.add_argument("--kv_host_pool_mb", type=int, default=8,
+                   help="replay --paging: host-DRAM pool for the paging "
+                        "leg")
+    p.add_argument("--kv_spill_dir", default="",
+                   help="replay --paging: also exercise the disk spill "
+                        "tier (safetensors files in this directory)")
     args = p.parse_args(argv)
 
     rates = [float(r) for r in args.rates.split(",")]
-    if args.mode == "replay":
+    if args.mode == "replay" and args.paging:
+        result = run_paging_replay(
+            seed=args.seed, requests=args.requests, rate_rps=rates[0],
+            resume_fraction=args.resume_fraction,
+            idle_gap_s=args.idle_gap_s, time_scale=args.time_scale,
+            slo_path=args.slo,
+            slo_workload=args.slo_workload or "paging-smoke",
+            max_queue=args.max_queue or 64,
+            kv_host_pool_mb=args.kv_host_pool_mb,
+            kv_spill_dir=args.kv_spill_dir)
+        key = "paging"
+    elif args.mode == "replay":
         result = run_replay(
             workload_trace=args.workload_trace, seed=args.seed,
             requests=args.requests, rate_rps=rates[0],

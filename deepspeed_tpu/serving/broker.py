@@ -333,7 +333,11 @@ class RequestBroker:
     def kv_utilization(self) -> float:
         """Fraction of KV blocks NOT available to new work.  Evictable
         prefix-cache blocks count as free — a warm cache must not look
-        like pool pressure to deferral / shedding logic."""
+        like pool pressure to deferral / shedding logic.  With the paging
+        tier attached (``--kv_host_pool_mb``), cached blocks stay
+        recoverable even under ``prefix_eviction="none"``: demotion to
+        host DRAM is lossless, so ``reclaimable_blocks`` includes them and
+        admission keeps counting them as capacity."""
         e = self.engine
         reclaimable = e.free_blocks + e.reclaimable_blocks
         return 1.0 - reclaimable / max(e.total_blocks, 1)
@@ -634,3 +638,9 @@ class RequestBroker:
             with self._wake:
                 self._dead = f"engine_error: {e!r}"
                 self._fail_all_locked("engine_error")
+        finally:
+            # release paging-tier resources (promote-ahead thread, spill
+            # writer) with the engine thread — nobody else owns the engine
+            close = getattr(self.engine, "close", None)
+            if close is not None:
+                close()

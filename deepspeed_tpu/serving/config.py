@@ -4,6 +4,16 @@ Capability analogue of DeepSpeed-MII's deployment config (``mii/config.py``
 ``ModelConfig``/``MIIConfig``: replica counts, queue sizes, ports). A plain
 dataclass like :class:`inference.v2.engine.V2Config` — the serving layer sits
 outside the pydantic training-config tree.
+
+Engine-side knobs (geometry, prefix cache, speculative decoding, and the
+serving memory hierarchy ``--kv_host_pool_mb`` / ``--kv_spill_dir`` /
+``--kv_promote_ahead``) are NOT here: they live in ``V2Config`` and are
+registered by ``server.add_engine_cli_args`` so the in-process front and
+the out-of-process worker build bit-identical engines from one flag set.
+The paging tier still shapes serving behaviour through this layer's
+numbers: demoted blocks stay reclaimable, so ``broker.kv_utilization``
+(deferral/shedding) and heartbeat ``prefix_summary`` digests (cache-aware
+routing) keep counting sessions whose KV currently lives off-device.
 """
 
 from __future__ import annotations

@@ -148,6 +148,16 @@ class ServingMetrics:
             "cached_blocks": 0, "shared_blocks": 0, "evictable_blocks": 0,
             "pinned_blocks": 0,
         }
+        # serving memory hierarchy mirror (engine-owned tier gauges +
+        # demote/promote counters from inference/v2/paging.py, summed over
+        # replicas by the pump; all zero without --kv_host_pool_mb).  A
+        # separate family from ``prefix`` so the tier gauges get their own
+        # dstpu_serving_kv_* names without double-emitting prefix_* keys.
+        self.kv: Dict[str, float] = {
+            "tier_device_blocks": 0, "tier_host_blocks": 0,
+            "tier_spill_blocks": 0, "demotions": 0, "promotions": 0,
+            "promote_wait_ms": 0.0,
+        }
         # speculative-decoding mirror (engine-owned counters, summed over
         # replicas by the pump; all zero when spec_mode is "off")
         self.spec: Dict[str, float] = {
@@ -288,6 +298,9 @@ class ServingMetrics:
             for k in self.prefix:
                 if k in stats:
                     self.prefix[k] = stats[k]
+            for k in self.kv:
+                if k in stats:
+                    self.kv[k] = stats[k]
 
     def set_spec_stats(self, stats: Dict[str, float]) -> None:
         """Mirror engine speculative-decoding stats (see
@@ -326,6 +339,8 @@ class ServingMetrics:
                     out[f"{name}_{k}"] = v
             for k, v in self.prefix.items():
                 out[f"prefix_{k}"] = float(v)
+            for k, v in self.kv.items():
+                out[f"kv_{k}"] = float(v)
             for k, v in self.spec.items():
                 out[f"spec_{k}"] = float(v)
             for k, v in self.fleet.items():
@@ -390,6 +405,10 @@ class ServingMetrics:
             b.gauge(f"{pre}prefix_{k}",
                     f"Prefix cache: {k.replace('_', ' ')}.",
                     snap[f"prefix_{k}"])
+        for k in self.kv:
+            b.gauge(f"{pre}kv_{k}",
+                    f"KV memory hierarchy: {k.replace('_', ' ')}.",
+                    snap[f"kv_{k}"])
         for k in self.spec:
             b.gauge(f"{pre}spec_{k}",
                     f"Speculative decoding: {k.replace('_', ' ')}.",

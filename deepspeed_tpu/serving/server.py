@@ -368,6 +368,9 @@ def build_engine_factory(args) -> Callable[[], "object"]:
                   enable_prefix_cache=args.enable_prefix_cache,
                   prefix_cache_min_tokens=args.prefix_cache_min_tokens,
                   prefix_eviction=args.prefix_eviction,
+                  kv_host_pool_mb=args.kv_host_pool_mb,
+                  kv_spill_dir=args.kv_spill_dir,
+                  kv_promote_ahead=args.kv_promote_ahead,
                   spec_mode=args.spec_mode, spec_k=args.spec_k,
                   quantize_bits=args.quantize_bits,
                   quantize_group=args.quantize_group)
@@ -420,6 +423,12 @@ def engine_argv_from_args(args) -> List[str]:
             "--quantize_group", str(args.quantize_group)]
     if args.enable_prefix_cache:
         argv.append("--enable_prefix_cache")
+    if args.kv_host_pool_mb:
+        argv += ["--kv_host_pool_mb", str(args.kv_host_pool_mb)]
+    if args.kv_spill_dir:
+        argv += ["--kv_spill_dir", args.kv_spill_dir]
+    if args.kv_promote_ahead:
+        argv.append("--kv_promote_ahead")
     if args.spec_draft_model:
         argv += ["--spec_draft_model", args.spec_draft_model]
     if args.spec_draft_seed is not None:
@@ -521,6 +530,20 @@ def add_engine_cli_args(p) -> None:
                    help="minimum shareable prefix length to take a cache hit")
     p.add_argument("--prefix_eviction", choices=["lru", "none"],
                    default="lru")
+    p.add_argument("--kv_host_pool_mb", type=int, default=0,
+                   help="serving memory hierarchy: demote cold prefix-cache "
+                        "blocks into a host-DRAM pool of this many MiB "
+                        "instead of evicting them, so a returning session "
+                        "promotes KV back instead of recomputing prefill "
+                        "(0 = off; needs --enable_prefix_cache)")
+    p.add_argument("--kv_spill_dir", default="",
+                   help="third memory tier: when the host pool overflows, "
+                        "spill its oldest blocks to safetensors files in "
+                        "this directory (FastPersist O_DIRECT writer)")
+    p.add_argument("--kv_promote_ahead", action="store_true",
+                   help="background thread prefetches spilled blocks into "
+                        "host DRAM as soon as a request referencing them is "
+                        "queued, overlapping disk reads with earlier steps")
     p.add_argument("--quantize_bits", type=int, default=0,
                    choices=[0, 4, 6, 8],
                    help="weight-only quantization of the served base: "

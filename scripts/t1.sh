@@ -168,6 +168,18 @@ then
     exit 2
 fi
 
+# paging suite: imports the host-DRAM/spill block pager (inference/v2/
+# paging.py), the tiered radix-tree demote/promote path, and the
+# FastPersist O_DIRECT spill writer
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_paging.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_paging.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi
@@ -190,9 +202,13 @@ T1_GROUPS=${T1_GROUPS:-6}
 # test_fleet gets its own partition too so the three chaos-heavy suites
 # (fleet/remote-fleet/disagg) can run under DSTPU_LOCKDEP=1 — every
 # failover/fencing/autoscale path is lock-order-checked on every CI run
-# (conftest.pytest_sessionfinish asserts the report empty mod waivers)
+# (conftest.pytest_sessionfinish asserts the report empty mod waivers).
+# test_paging joins them: the pager's promote-ahead thread and spill
+# writer interleave with the broker/engine locks, so the whole tiered-KV
+# suite runs lock-order-checked too.
 mapfile -t T1_FILES < <(ls tests/test_*.py \
     | grep -v -e 'test_remote_fleet' -e 'test_disagg' -e 'test_fleet\.py' \
+        -e 'test_paging' \
     | sort)
 rc=0
 rm -f /tmp/_t1.log
@@ -227,6 +243,15 @@ fi
 echo "== t1: group disagg (lockdep): tests/test_disagg.py =="
 timeout -k 10 1800 env JAX_PLATFORMS=cpu DSTPU_LOCKDEP=1 \
     python -m pytest tests/test_disagg.py -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
+grc=${PIPESTATUS[0]}
+if [ "$grc" -ne 0 ] && [ "$grc" -ne 5 ]; then
+    rc=$grc
+fi
+echo "== t1: group paging (lockdep): tests/test_paging.py =="
+timeout -k 10 1800 env JAX_PLATFORMS=cpu DSTPU_LOCKDEP=1 \
+    python -m pytest tests/test_paging.py -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
 grc=${PIPESTATUS[0]}

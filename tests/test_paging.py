@@ -1,0 +1,394 @@
+"""Serving memory hierarchy: host-DRAM paging tier for cold KV blocks
+(ZeRO-Infinity for inference).
+
+Demote-instead-of-evict over the radix prefix cache: LRU-cold tree nodes
+serialize their KV block to a host byte pool (third tier: FastPersist
+spill files) and stay in the tree; a later match promotes the bytes back
+into a fresh device block instead of recomputing prefill.  Tests cover
+byte/token exactness of the demote→promote roundtrip on both paged
+tiers, the extended allocator identity with demoted blocks, pressure
+soaks with zero leaks, promote-vs-cancel concurrency, the COW-alias
+dedupe regression, and HLO identity paging on/off.  The whole file also
+runs under ``DSTPU_LOCKDEP=1`` in its own tier-1 partition (scripts/
+t1.sh): the pager's background promote-ahead thread and spill writer are
+lock-order-checked on every CI run.
+"""
+
+import glob
+import os
+import tempfile
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine import InferenceEngineV2, V2Config
+from deepspeed_tpu.inference.v2.paging import (BlockPager, deserialize_block,
+                                               serialize_block)
+from deepspeed_tpu.inference.v2.prefix_cache import PrefixCache
+from deepspeed_tpu.inference.v2.ragged import BlockedAllocator
+from deepspeed_tpu.models import transformer as tfm
+from deepspeed_tpu.serving import RequestBroker, ServingConfig, ServingMetrics
+
+V2 = dict(max_tokens_per_step=32, max_seqs=4, block_size=8, num_blocks=64,
+          max_blocks_per_seq=8, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tfm.get_config("tiny", dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def ref_fn(tiny_model):
+    """Greedy continuation via the plain uncached forward — the reference
+    every paged decode must match token-for-token."""
+    cfg, params = tiny_model
+    cache = {}
+
+    def ref(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in cache:
+            seq = np.array([list(prompt)], np.int32)
+            for _ in range(n):
+                logits = tfm.forward(params, seq, cfg)
+                nxt = np.asarray(logits[:, -1].argmax(-1)).astype(np.int32)
+                seq = np.concatenate([seq, nxt[:, None]], axis=1)
+            cache[key] = seq[0, len(prompt):].tolist()
+        return cache[key]
+
+    return ref
+
+
+def _engine(tiny_model, **over):
+    cfg, params = tiny_model
+    return InferenceEngineV2(
+        cfg, params, V2Config(**{**V2, "enable_prefix_cache": True, **over}))
+
+
+def _assert_consistent(eng, idle=True):
+    """The ISSUE's extended identity: device_free + evictable + pinned +
+    demoted == total + demoted, with demoted agreed on three ways
+    (allocator counter, pager residency, tree node count)."""
+    eng.prefix_cache.check_consistency()
+    free, ev, pin, tot = (eng.free_blocks, eng.evictable_blocks,
+                          eng.pinned_blocks, eng.total_blocks)
+    assert free + ev + pin == tot, (free, ev, pin, tot)
+    if idle:
+        assert pin == 0, f"{pin} blocks pinned with no live sequence"
+
+
+# ---------------------------------------------------------------------------
+# block serialization + pager tiers (no model)
+# ---------------------------------------------------------------------------
+
+
+def test_serialize_block_roundtrip():
+    rng = np.random.default_rng(0)
+    arrays = {"k": rng.standard_normal((2, 8, 2, 16)).astype(np.float32),
+              "v": np.arange(24, dtype=np.int32).reshape(2, 3, 4)}
+    back = deserialize_block(serialize_block(arrays, {"note": "t"}))
+    assert sorted(back) == ["k", "v"]
+    for name in arrays:
+        assert back[name].dtype == arrays[name].dtype
+        assert np.array_equal(back[name], arrays[name])
+
+
+def test_pager_host_tier_put_get_drop():
+    pg = BlockPager(host_bytes=1 << 20)
+    arrays = {"k": np.full((4, 16), 7.5, np.float32)}
+    handle, tier = pg.put(arrays)
+    assert tier == "host" and pg.host_blocks == 1
+    got = pg.get(handle)
+    assert np.array_equal(got["k"], arrays["k"])
+    # get does NOT consume: the caller drops only after the device
+    # scatter succeeded
+    assert pg.get(handle) is not None
+    pg.drop(handle)
+    assert pg.get(handle) is None and pg.resident_blocks == 0
+    # no spill tier: a pool too small for the payload refuses (caller
+    # degrades to plain eviction), it never silently drops bytes
+    tiny = BlockPager(host_bytes=64)
+    assert tiny.put({"k": np.zeros((64, 64), np.float32)}) is None
+    tiny.close()
+    pg.close()
+    pg.close()  # idempotent
+
+
+def test_pager_spill_overflow_prefetch_and_unlink(tmp_path):
+    pg = BlockPager(host_bytes=3000, spill_dir=str(tmp_path),
+                    promote_ahead=True)
+    handles = [pg.put({"k": np.full((4, 32), i, np.float32)})[0]
+               for i in range(6)]
+    st = pg.stats()
+    assert st["tier_spill_blocks"] > 0 and st["spills"] > 0
+    assert glob.glob(str(tmp_path / "*.safetensors"))
+    # prefetch stages spilled blocks off the critical path; a racing
+    # drop must win (entry gone, file unlinked) without crashing
+    pg.prefetch(handles)
+    pg.drop(handles[0])
+    for i, h in enumerate(handles[1:], start=1):
+        got = pg.get(h)
+        assert got is not None and float(got["k"][0, 0]) == float(i)
+        pg.drop(h)
+    deadline = time.monotonic() + 5
+    while glob.glob(str(tmp_path / "*.safetensors")):
+        assert time.monotonic() < deadline, "spill files not unlinked"
+        time.sleep(0.05)
+    assert pg.resident_blocks == 0
+    pg.close()
+
+
+# ---------------------------------------------------------------------------
+# demote → promote roundtrip: token-identical decode on both tiers
+# ---------------------------------------------------------------------------
+
+
+def test_host_tier_demote_promote_token_exact(devices, tiny_model, ref_fn):
+    """Whole tree demoted to host DRAM; the resumed session promotes its
+    prefix back and decodes the exact uncached-reference continuation."""
+    eng = _engine(tiny_model, kv_host_pool_mb=8)
+    assert eng.pager is not None
+    pA = list(range(1, 21))
+    u = eng.put(list(pA), max_new_tokens=6)
+    assert eng.generate_all()[u][len(pA):] == ref_fn(pA, 6)
+    assert eng.prefix_cache.evict(100) > 0  # demotes, nothing is lost
+    s = eng.prefix_stats()
+    assert s["tier_host_blocks"] > 0 and s["tier_device_blocks"] == 0
+    assert s["demotions"] > 0 and s["cached_blocks"] > 0
+    _assert_consistent(eng)
+
+    u2 = eng.put(list(pA), max_new_tokens=6)
+    assert eng.generate_all()[u2][len(pA):] == ref_fn(pA, 6)
+    s = eng.prefix_stats()
+    assert s["promotions"] > 0 and s["hits"] >= 1
+    assert s["prefill_tokens_skipped"] >= 16  # promote, not recompute
+    _assert_consistent(eng)
+    eng.close()
+    eng.close()  # idempotent
+
+
+def test_spill_tier_demote_promote_token_exact(devices, tiny_model, ref_fn,
+                                               tmp_path):
+    """A host pool too small for even one block pushes every demotion
+    through the FastPersist spill files — decode stays token-exact."""
+    eng = _engine(tiny_model)
+    eng.pager = BlockPager(host_bytes=1, spill_dir=str(tmp_path))
+    eng.prefix_cache.attach_pager(eng.pager, eng._demote_node,
+                                  eng._promote_node)
+    pA = list(range(1, 21))
+    u = eng.put(list(pA), max_new_tokens=6)
+    assert eng.generate_all()[u][len(pA):] == ref_fn(pA, 6)
+    assert eng.prefix_cache.evict(100) > 0
+    s = eng.prefix_stats()
+    assert s["tier_spill_blocks"] > 0 and s["tier_host_blocks"] == 0
+    assert glob.glob(str(tmp_path / "*.safetensors"))
+    _assert_consistent(eng)
+
+    u2 = eng.put(list(pA), max_new_tokens=6)
+    assert eng.generate_all()[u2][len(pA):] == ref_fn(pA, 6)
+    assert eng.prefix_stats()["promotions"] > 0
+    _assert_consistent(eng)
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# allocator identity with demoted blocks
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_demoted_accounting(devices, tiny_model, ref_fn):
+    eng = _engine(tiny_model, kv_host_pool_mb=8)
+    pA = list(range(1, 21))
+    eng.put(list(pA), max_new_tokens=6)
+    eng.generate_all()
+    demoted = eng.prefix_cache.evict(100)
+    alloc = eng.kv.allocator
+    assert alloc.demoted == demoted == eng.prefix_cache.demoted_blocks
+    assert eng.pager.resident_blocks == demoted
+    _assert_consistent(eng)
+    # promote drains the counter back to zero...
+    eng.put(list(pA), max_new_tokens=6)
+    eng.generate_all()
+    assert alloc.demoted == eng.prefix_cache.demoted_blocks
+    _assert_consistent(eng)
+    # ...and below zero is a hard accounting error
+    with pytest.raises(AssertionError, match="no demoted blocks"):
+        for _ in range(alloc.demoted + 1):
+            alloc.note_promote()
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# pressure-driven demotion soak: zero leaks, exact outputs
+# ---------------------------------------------------------------------------
+
+
+def test_pressure_demotion_soak_zero_leaks(devices, tiny_model, ref_fn):
+    """Distinct prompts overflow a small device pool: pressure demotes
+    cold subtrees to host instead of evicting, every output stays exact,
+    and the tier identity holds after every request."""
+    eng = _engine(tiny_model, num_blocks=17, max_seqs=2, kv_host_pool_mb=8)
+    for i in range(16):
+        p = [10 * i + j for j in range(1, 13)]
+        uid = eng.put(p, max_new_tokens=4)
+        out = eng.generate_all()[uid][len(p):]
+        assert out == ref_fn(p, 4), f"prompt {i}"
+        _assert_consistent(eng)
+    s = eng.prefix_stats()
+    assert s["demotions"] > 0, "no pressure reached the pager"
+    # demote-instead-of-evict kept cold prefixes resident in SOME tier
+    assert s["tier_host_blocks"] + s["tier_spill_blocks"] > 0
+    # resuming an early (now cold) session promotes instead of recomputing
+    p0 = [j for j in range(1, 13)]
+    uid = eng.put(list(p0), max_new_tokens=4)
+    assert eng.generate_all()[uid][len(p0):] == ref_fn(p0, 4)
+    assert eng.prefix_stats()["promotions"] > 0
+    _assert_consistent(eng)
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# concurrency: promote vs cancel through the serving broker
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_promote_vs_cancel(devices, tiny_model, ref_fn):
+    """Resumed sessions promoting demoted prefixes while half of them are
+    cancelled immediately: survivors stay token-exact, nothing leaks, the
+    tier identity holds.  Under ``DSTPU_LOCKDEP=1`` (the t1 paging
+    partition) this also order-checks the pager locks against the broker
+    and engine locks."""
+    eng = _engine(tiny_model, num_blocks=17, max_seqs=2,
+                  kv_host_pool_mb=8, kv_promote_ahead=True)
+    broker = RequestBroker(eng, ServingConfig()).start()
+    # 10 sessions x 2 blocks > the 16-block device pool: the warm wave
+    # must pressure-demote the oldest sessions' prefixes
+    prompts = [[10 * i + j for j in range(1, 13)] for i in range(10)]
+    try:
+        for p in prompts:  # warm wave: builds + pressure-demotes the tree
+            assert broker.submit(list(p), max_new_tokens=4).result(
+                timeout=120) == ref_fn(p, 4)
+        assert eng.prefix_stats()["demotions"] > 0
+        # resume wave: all at once, cancel the even ones right away
+        handles = [broker.submit(list(p), max_new_tokens=4)
+                   for p in prompts]
+        for h in handles[::2]:
+            h.cancel()
+        for i, h in enumerate(handles):
+            if i % 2 == 1:
+                assert h.result(timeout=120) == ref_fn(prompts[i], 4), i
+        deadline = time.monotonic() + 15
+        while eng.num_running or eng.num_waiting:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert eng.prefix_stats()["promotions"] > 0
+        _assert_consistent(eng)
+    finally:
+        broker.stop()
+    # the broker's engine-loop teardown closed the pager with the engine
+    assert eng.pager._closed
+
+
+# ---------------------------------------------------------------------------
+# COW-alias dedupe regression (satellite fix, no model)
+# ---------------------------------------------------------------------------
+
+
+def test_evict_alias_dedupe_regression():
+    """Two leaf paths on ONE block (each holding its own tree reference):
+    pressure math must count the block once, and evicting the group must
+    report one freed block — the old per-node accounting double-counted
+    it as reclaimable capacity."""
+    a = BlockedAllocator(8)
+    pc = PrefixCache(a, block_size=4)
+    (b,) = a.allocate(1)
+    a.incref(b)
+    pc.donate([1, 2, 3, 4], 4, [b])
+    pc.donate([5, 6, 7, 8], 4, [b])
+    assert pc.cached_blocks == 2  # two nodes...
+    assert pc.evictable_blocks == 1  # ...one reclaimable block
+    assert pc.shared_blocks == 0
+    assert pc.evict(10) == 1  # the whole alias group, counted once
+    assert a.free_blocks == 8 and pc.cached_blocks == 0
+    a.check_consistency()
+    # a live sequence pinning the aliased block blocks the whole group
+    (b2,) = a.allocate(1)
+    a.incref(b2)
+    pc.donate([1, 2, 3, 4], 4, [b2])
+    pc.donate([5, 6, 7, 8], 4, [b2])
+    a.incref(b2)  # the "sequence"
+    assert pc.evictable_blocks == 0 and pc.shared_blocks == 1
+    assert pc.evict(10) == 0
+    a.free([b2])
+    assert pc.evict(10) == 1 and a.free_blocks == 8
+    a.check_consistency()
+    # reset with aliases: each node drops exactly its own reference
+    (b3,) = a.allocate(1)
+    a.incref(b3)
+    pc.donate([1, 2, 3, 4], 4, [b3])
+    pc.donate([5, 6, 7, 8], 4, [b3])
+    assert pc.reset() == 2 and a.free_blocks == 8
+    a.check_consistency()
+
+
+# ---------------------------------------------------------------------------
+# serving gauges: dstpu_serving_kv_* family
+# ---------------------------------------------------------------------------
+
+
+def test_kv_tier_metrics_exposition():
+    m = ServingMetrics()
+    m.set_prefix_stats({"enabled": 1, "lookups": 4, "hits": 2,
+                        "tier_device_blocks": 5, "tier_host_blocks": 3,
+                        "tier_spill_blocks": 1, "demotions": 9,
+                        "promotions": 4, "promote_wait_ms": 12.5})
+    snap = m.snapshot()
+    assert snap["kv_tier_host_blocks"] == 3
+    assert snap["kv_tier_spill_blocks"] == 1
+    assert snap["kv_demotions"] == 9 and snap["kv_promotions"] == 4
+    text = m.to_prometheus()
+    for key in ("dstpu_serving_kv_tier_device_blocks",
+                "dstpu_serving_kv_tier_host_blocks",
+                "dstpu_serving_kv_tier_spill_blocks",
+                "dstpu_serving_kv_demotions",
+                "dstpu_serving_kv_promotions",
+                "dstpu_serving_kv_promote_wait_ms"):
+        assert key in text, key
+
+
+# ---------------------------------------------------------------------------
+# HLO identity: paging must not change the compiled step programs
+# ---------------------------------------------------------------------------
+
+
+def test_decode_program_identical_with_paging(devices, tiny_model):
+    """Paging is host-side bookkeeping (serialize/scatter around the
+    compiled graph): the lowered decode program with the pager on is
+    bit-identical to pager off."""
+    cfg, params = tiny_model
+
+    def lowered(paging):
+        over = {"kv_host_pool_mb": 8, "kv_promote_ahead": True} \
+            if paging else {}
+        eng = InferenceEngineV2(
+            cfg, params,
+            V2Config(**{**V2, "enable_prefix_cache": True, **over}))
+        seqs = eng.cfg.max_seqs
+        toks = np.zeros((seqs,), np.int32)
+        pos = np.zeros((seqs,), np.int32)
+        tables = np.zeros((seqs, eng.cfg.max_blocks_per_seq), np.int32)
+        ctx = np.ones((seqs,), np.int32)
+        temps = np.zeros((seqs,), np.float32)
+        seeds = np.zeros((seqs,), np.int32)
+        txt = eng._decode_fwd.lower(eng.params, eng.caches, toks, pos,
+                                    tables, ctx, temps,
+                                    jax.random.PRNGKey(0),
+                                    seeds).as_text()
+        eng.close()
+        return txt
+
+    assert lowered(True) == lowered(False)
