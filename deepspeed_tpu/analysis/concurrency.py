@@ -39,6 +39,7 @@ from .strict_toml import StrictTomlError, check_keys, load_toml, require
 __all__ = [
     "ConcurrencyError",
     "apply_waivers",
+    "check_frame_fields",
     "check_frame_protocol",
     "default_protocol_paths",
     "default_waivers_path",
@@ -268,6 +269,144 @@ def check_frame_protocol(
     return problems
 
 
+# -- frame-field exhaustiveness (submit + heartbeat payloads) -------------
+#
+# Op/ev literals cover frame TYPES; these checks cover frame FIELDS — the
+# drift that bites when a new per-request knob (tenant, seed, adapter)
+# rides the submit frame: the transport serializes it from a literal key
+# tuple, the worker reads it with ``frame.get(...)``, and a key present
+# on only one side is silently dropped (the request runs without its
+# knob).  Same shape for heartbeats: every stats key the pool-side
+# transport reads must be produced by the worker's ``_stats`` builder.
+
+#: submit-frame keys that are structural, not optional per-request knobs
+_SUBMIT_STRUCTURAL = {"op", "rid", "prompt", "trace"}
+
+
+def _find_func(tree: ast.AST, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _submit_keys_sent(transport_src: str, path: str) -> Set[str]:
+    """The optional-key tuple FramedReplica.submit serializes: the
+    ``for key in (<literals>)`` loop containing 'max_new_tokens'."""
+    tree = ast.parse(transport_src, filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For):
+            lits = _str_consts(node.iter)
+            if lits and "max_new_tokens" in lits:
+                return set(lits)
+    return set()
+
+
+def _frame_get_keys(worker_src: str, path: str) -> Set[str]:
+    """Every ``frame.get("<key>")`` / ``frame["<key>"]`` read in the
+    worker's op loop."""
+    tree = ast.parse(worker_src, filename=path)
+    keys: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "frame" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            keys.add(node.args[0].value)
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "frame" and \
+                isinstance(node.slice, ast.Constant) and \
+                isinstance(node.slice.value, str):
+            keys.add(node.slice.value)
+    return keys
+
+
+def _hb_keys_produced(worker_src: str, path: str) -> Set[str]:
+    """Stats keys the worker's ``_stats`` builder emits: the returned
+    dict literal's keys plus ``stats["<key>"] = ...`` augmentations."""
+    tree = ast.parse(worker_src, filename=path)
+    fn = _find_func(tree, "_stats")
+    keys: Set[str] = set()
+    if fn is None:
+        return keys
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.slice, ast.Constant) and \
+                        isinstance(tgt.slice.value, str):
+                    keys.add(tgt.slice.value)
+    return keys
+
+
+def _hb_keys_consumed(transport_src: str, path: str) -> Set[str]:
+    """Stats keys the pool-side transport reads off heartbeats:
+    ``self._stat("<key>")`` and ``self._stats.get("<key>")``."""
+    tree = ast.parse(transport_src, filename=path)
+    keys: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.args and
+                isinstance(node.args[0], ast.Constant) and
+                isinstance(node.args[0].value, str)):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "_stat":
+            keys.add(node.args[0].value)
+        elif isinstance(f, ast.Attribute) and f.attr == "get" and \
+                isinstance(f.value, ast.Attribute) and \
+                f.value.attr == "_stats":
+            keys.add(node.args[0].value)
+    return keys
+
+
+def check_frame_fields(paths: Optional[Sequence[str]] = None) -> List[str]:
+    """Field-level exhaustiveness across the submit and heartbeat frames:
+
+    * every optional submit key the transport serializes must be read by
+      the worker (``frame.get``), or the knob silently no-ops remotely;
+    * every heartbeat stats key the pool-side transport reads must be
+      produced by the worker's ``_stats`` builder, or the gauge silently
+      reads its default forever.
+    """
+    paths = list(paths) if paths is not None else default_protocol_paths()
+    by_name = {os.path.basename(p): p for p in paths}
+    problems: List[str] = []
+    tp, wp = by_name.get("transport.py"), by_name.get("worker.py")
+    if tp is None or wp is None:
+        return ["frame-field check needs transport.py and worker.py"]
+    with open(tp) as f:
+        transport_src = f.read()
+    with open(wp) as f:
+        worker_src = f.read()
+    sent = _submit_keys_sent(transport_src, tp)
+    if not sent:
+        problems.append("transport.py: submit optional-key tuple not found "
+                        "(the serializer loop moved?)")
+    read = _frame_get_keys(worker_src, wp) | _SUBMIT_STRUCTURAL
+    for key in sorted(sent - read):
+        problems.append(
+            f"submit field {key!r} is serialized by transport.py but never "
+            f"read by worker.py — the knob silently no-ops out-of-process")
+    produced = _hb_keys_produced(worker_src, wp)
+    if not produced:
+        problems.append("worker.py: _stats() heartbeat builder not found")
+    consumed = _hb_keys_consumed(transport_src, tp)
+    for key in sorted(consumed - produced):
+        problems.append(
+            f"heartbeat stats key {key!r} is read by transport.py but "
+            f"never produced by worker.py _stats() — the gauge reads its "
+            f"default forever")
+    return problems
+
+
 # -- CLI (the t1.sh static gate) ------------------------------------------
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -287,6 +426,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         print("concurrency: frame protocol exhaustive "
               f"({', '.join(_PROTOCOL_FILES)})")
+    field_problems = check_frame_fields()
+    if field_problems:
+        for p in field_problems:
+            print(f"concurrency: FIELDS: {p}", file=sys.stderr)
+        rc = 1
+    else:
+        print("concurrency: submit/heartbeat frame fields exhaustive")
     return rc
 
 

@@ -11,7 +11,7 @@ registry compile stays under a minute on a CI box.
 
 Program names are the budget keys: ``train_step@zero{0..3}``,
 ``train_step@lora``, ``decode_step@v2``, ``decode_step@v2_quant``,
-``spec_decode_step@v2``, ``onebit_step``.
+``decode_step@v2_adapters``, ``spec_decode_step@v2``, ``onebit_step``.
 """
 
 from __future__ import annotations
@@ -189,9 +189,14 @@ def _decode_v2_artifact(name: str, **v2_extra: Any) -> ProgramArtifact:
     # host syncs and the KV caches aliased in place
     temps = np.zeros((seqs,), np.float32)
     seeds = np.zeros((seqs,), np.int32)
+    # multi-adapter engines extend the decode signature with the stacked
+    # LoRA factors and the per-row adapter-index vector (trailing args) —
+    # plain engines compile the exact historical signature, byte-identical
+    ad_args = () if eng.adapter_stack is None else (
+        eng.adapter_stack, np.zeros((seqs,), np.int32))
     compiled = eng._decode_fwd.lower(
         eng.params, eng.caches, tokens, positions, tables, ctx_lens,
-        temps, jax.random.PRNGKey(0), seeds).compile()
+        temps, jax.random.PRNGKey(0), seeds, *ad_args).compile()
     ctx = AnalysisContext(
         program=name,
         compute_dtype="bf16",
@@ -219,6 +224,20 @@ def _decode_v2_quant_program() -> ProgramArtifact:
     # i.e. no full-matrix dequant anywhere
     return _decode_v2_artifact("decode_step@v2_quant",
                                quantize_bits=8, quantize_group=704)
+
+
+def _decode_v2_adapters_program() -> ProgramArtifact:
+    # the multi-tenant flagship: batched heterogeneous-adapter decode over
+    # the SAME W8A16 base as decode_step@v2_quant.  Each row gathers its
+    # own (A, B) factor pair from the stacked device-resident slots and
+    # adds the low-rank delta on top of the unchanged quantized projection
+    # — the budget proves the base still reads at s8 width (entry bytes
+    # identical to the quant flagship), the adapter stack rides as bf16
+    # entry params, and the per-row dispatch compiles to gathers with zero
+    # host syncs (no per-adapter program switches, no re-tracing)
+    return _decode_v2_artifact("decode_step@v2_adapters",
+                               quantize_bits=8, quantize_group=704,
+                               adapter_slots=4, adapter_rank=8)
 
 
 def _spec_decode_program() -> ProgramArtifact:
@@ -271,6 +290,7 @@ _PROGRAMS: Dict[str, Callable[[], ProgramArtifact]] = {
     "train_step@lora": _lora_program,
     "decode_step@v2": _decode_v2_program,
     "decode_step@v2_quant": _decode_v2_quant_program,
+    "decode_step@v2_adapters": _decode_v2_adapters_program,
     "spec_decode_step@v2": _spec_decode_program,
     "onebit_step": _onebit_program,
 }

@@ -90,6 +90,15 @@ class V2Config:
     # quantized width (int8: K·N bytes, int4: K·N/2) instead of 2·K·N bf16
     quantize_bits: int = 0  # 0 = off; 4 / 6 / 8 = W4A16 / W6A16 / W8A16
     quantize_group: int = 256  # per-group scale granularity along K
+    # multi-tenant LoRA serving (serving/adapters.py): a device-resident
+    # stack of per-slot adapter factors rides every forward as an extra
+    # read-only argument; each row gathers ITS slot's A/B and adds the
+    # low-rank delta on top of the unchanged (quantized) base projections.
+    # Slot 0 is reserved as the all-zero null adapter, so base-only rows
+    # stay bit-identical to an adapterless engine.  0 disables entirely —
+    # every compiled program is then byte-identical to pre-adapter builds.
+    adapter_slots: int = 0  # total device slots INCLUDING the null slot 0
+    adapter_rank: int = 0  # stack rank r (shorter adapters are zero-padded)
 
 
 # ---------------------------------------------------------------------------
@@ -120,6 +129,56 @@ def sample_rows(logits, temps, rng, seeds):
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
     return jnp.where(temps > 0.0, sampled, greedy)
+
+
+# ---------------------------------------------------------------------------
+# batched heterogeneous-adapter LoRA (S-LoRA / Punica shape)
+# ---------------------------------------------------------------------------
+
+#: projections the device adapter stack can carry deltas for — the
+#: attention projections of ``models/transformer.py`` (classic LoRA
+#: targets).  MLP-targeted adapters are rejected at registry load; the
+#: serving path never silently drops part of an adapter.
+ADAPTER_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def adapter_target_shapes(model_cfg: tfm.TransformerConfig
+                          ) -> Dict[str, Tuple[int, int]]:
+    """(K, N) of each stackable projection — what a loaded adapter's
+    ``lora_a (L, K, r)`` / ``lora_b (L, r, N)`` must match."""
+    H = model_cfg.hidden_size
+    qd = model_cfg.num_heads * model_cfg.head_dim
+    kvd = model_cfg.kv_heads * model_cfg.head_dim
+    return {"wq": (H, qd), "wk": (H, kvd), "wv": (H, kvd), "wo": (qd, H)}
+
+
+def init_adapter_stack(model_cfg: tfm.TransformerConfig, v2: V2Config):
+    """All-zero device adapter stack: per target, ``a (L, slots, K, r)`` +
+    ``b (L, slots, r, N)`` in the compute dtype.  Slot 0 stays zero forever
+    (the null adapter); ``serving/adapters.py`` pages real adapters in and
+    out of slots ``1..slots-1`` with ``set_adapter_slot``."""
+    dt = jnp.dtype(v2.dtype)
+    L, S, r = model_cfg.num_layers, v2.adapter_slots, v2.adapter_rank
+    return {name: {"a": jnp.zeros((L, S, K, r), dt),
+                   "b": jnp.zeros((L, S, r, N), dt)}
+            for name, (K, N) in adapter_target_shapes(model_cfg).items()}
+
+
+def _adapter_proj_delta(x, ab, slots):
+    """Per-row gathered low-rank delta for one projection: row ``s`` adds
+    ``(x_s @ A[slots_s]) @ B[slots_s]`` (scaling folded into B at load).
+
+    ``x``: (S, K) or (S, Q, K) activations; ``ab``: this layer's stacked
+    factors {"a": (slots, K, r), "b": (slots, r, N)}; ``slots``: (S,)
+    int32.  Gather + two thin batched matmuls — in-graph, no host sync;
+    rows on the all-zero null slot add an exact zero."""
+    a_sel = ab["a"][slots]  # (S, K, r)
+    b_sel = ab["b"][slots]  # (S, r, N)
+    if x.ndim == 2:
+        return jnp.einsum("sr,srn->sn",
+                          jnp.einsum("sk,skr->sr", x, a_sel), b_sel)
+    return jnp.einsum("sqr,srn->sqn",
+                      jnp.einsum("sqk,skr->sqr", x, a_sel), b_sel)
 
 
 # ---------------------------------------------------------------------------
@@ -189,8 +248,9 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
     dt = jnp.dtype(v2.dtype)
     bs = v2.block_size
 
-    def fwd(params, caches, token_ids, position_ids, seq_index, block_tables,
-            context_lens, logits_rows, chunk_start, chunk_len):
+    def fwd_body(params, caches, token_ids, position_ids, seq_index,
+                 block_tables, context_lens, logits_rows, chunk_start,
+                 chunk_len, adapters=None, row_adapter=None):
         T = token_ids.shape[0]
         x = tfm.embed_tokens(params, token_ids, model_cfg,
                              position_ids=position_ids)  # (T, H)
@@ -219,12 +279,38 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
         scat_row, scat_col, gath_row, gath_col = prefill_scatter_coords(
             seq_index, position_ids, chunk_start, block_tables.shape[0], Qp)
 
+        # per-token adapter slot: each ragged token reads its row's slot
+        # (padding tokens pin to the null slot — their outputs are dropped
+        # and their KV writes park in scratch, but exact-zero is cheapest)
+        tok_slot = None
+        if adapters is not None:
+            tok_slot = jnp.where(
+                seq_index >= 0,
+                row_adapter[jnp.clip(seq_index, 0, row_adapter.shape[0] - 1)],
+                0)
+
+        xs = (params["layers"], caches["k"], caches["v"])
+        if adapters is not None:
+            xs = xs + (adapters,)
+
         def layer_body(x, inp):
-            lp, k_cache, v_cache = inp
+            if adapters is not None:
+                lp, k_cache, v_cache, ad = inp
+            else:
+                (lp, k_cache, v_cache), ad = inp, {}
             a_in = tfm._norm(x, lp["ln1"], model_cfg.norm, model_cfg.norm_eps)
-            q = tfm._lin(a_in, lp["attn"], "wq", "bq").reshape(T, nh, hd)
-            k = tfm._lin(a_in, lp["attn"], "wk", "bk").reshape(T, nkv, hd)
-            v = tfm._lin(a_in, lp["attn"], "wv", "bv").reshape(T, nkv, hd)
+            q = tfm._lin(a_in, lp["attn"], "wq", "bq")
+            k = tfm._lin(a_in, lp["attn"], "wk", "bk")
+            v = tfm._lin(a_in, lp["attn"], "wv", "bv")
+            if "wq" in ad:
+                q = q + _adapter_proj_delta(a_in, ad["wq"], tok_slot)
+            if "wk" in ad:
+                k = k + _adapter_proj_delta(a_in, ad["wk"], tok_slot)
+            if "wv" in ad:
+                v = v + _adapter_proj_delta(a_in, ad["wv"], tok_slot)
+            q = q.reshape(T, nh, hd)
+            k = k.reshape(T, nkv, hd)
+            v = v.reshape(T, nkv, hd)
             if model_cfg.position == "rope":
                 cos = cos_full[position_ids]
                 sin = sin_full[position_ids]
@@ -246,7 +332,11 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
                                             chunk_len)
             # padding rows read in-range garbage (clamped col), dropped later
             o = o_seq[gath_row, gath_col]  # (T, H, D)
-            attn_out = tfm._lin(o.reshape(T, nh * hd), lp["attn"], "wo", "bo")
+            o_flat = o.reshape(T, nh * hd)
+            attn_out = tfm._lin(o_flat, lp["attn"], "wo", "bo")
+            if "wo" in ad:
+                attn_out = attn_out + _adapter_proj_delta(
+                    o_flat, ad["wo"], tok_slot)
             m_src = x if model_cfg.parallel_residual else x + attn_out
             m_in = tfm._norm(m_src, lp["ln2"], model_cfg.norm,
                              model_cfg.norm_eps)
@@ -260,8 +350,8 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
                 else (m_src + mlp_out)
             return x, (k_cache, v_cache)
 
-        x, (new_k, new_v) = jax.lax.scan(
-            layer_body, x, (params["layers"], caches["k"], caches["v"]))
+        x, scan_out = jax.lax.scan(layer_body, x, xs)
+        new_k, new_v = scan_out[0], scan_out[1]
         x = tfm._norm(x, params["final_norm"], model_cfg.norm, model_cfg.norm_eps)
         last_hidden = x[logits_rows]  # (max_seqs, H)
         if model_cfg.tie_embeddings:
@@ -274,6 +364,22 @@ def build_ragged_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
         # carried state their next proposals are computed from)
         return (logits.astype(jnp.float32), last_hidden.astype(jnp.float32),
                 {"k": new_k, "v": new_v})
+
+    if v2.adapter_slots:
+        def fwd(params, caches, token_ids, position_ids, seq_index,
+                block_tables, context_lens, logits_rows, chunk_start,
+                chunk_len, adapters, row_adapter):
+            return fwd_body(params, caches, token_ids, position_ids,
+                            seq_index, block_tables, context_lens,
+                            logits_rows, chunk_start, chunk_len,
+                            adapters=adapters, row_adapter=row_adapter)
+    else:
+        def fwd(params, caches, token_ids, position_ids, seq_index,
+                block_tables, context_lens, logits_rows, chunk_start,
+                chunk_len):
+            return fwd_body(params, caches, token_ids, position_ids,
+                            seq_index, block_tables, context_lens,
+                            logits_rows, chunk_start, chunk_len)
 
     return _memo(("ragged_fwd", model_cfg, dataclasses.astuple(v2)),
                  lambda: jax.jit(fwd, donate_argnums=(1,)))
@@ -289,12 +395,21 @@ def build_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config):
     selected token ids, so a mixed greedy/sampled batch is one host-sync-free
     program (the ``decode_step@v2`` budget proves it)."""
 
-    def fwd(params, caches, token_ids, position_ids, block_tables,
-            context_lens, temps, rng, seeds):
-        logits, caches = _decode_body(params, caches, token_ids, position_ids,
-                                      block_tables, context_lens, model_cfg,
-                                      v2)
-        return sample_rows(logits, temps, rng, seeds), caches
+    if v2.adapter_slots:
+        def fwd(params, caches, token_ids, position_ids, block_tables,
+                context_lens, temps, rng, seeds, adapters, row_adapter):
+            logits, caches = _decode_body(
+                params, caches, token_ids, position_ids, block_tables,
+                context_lens, model_cfg, v2, adapters=adapters,
+                row_adapter=row_adapter)
+            return sample_rows(logits, temps, rng, seeds), caches
+    else:
+        def fwd(params, caches, token_ids, position_ids, block_tables,
+                context_lens, temps, rng, seeds):
+            logits, caches = _decode_body(params, caches, token_ids,
+                                          position_ids, block_tables,
+                                          context_lens, model_cfg, v2)
+            return sample_rows(logits, temps, rng, seeds), caches
 
     return _memo(("decode_fwd", model_cfg, dataclasses.astuple(v2)),
                  lambda: jax.jit(fwd, donate_argnums=(1,)))
@@ -313,8 +428,9 @@ def build_multi_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config,
 
     Returns (tokens_out (num_steps, max_seqs), caches)."""
 
-    def fwd(params, caches, token_ids, position_ids, block_tables, context_lens,
-            rng, temps, seeds):
+    def fwd_body(params, caches, token_ids, position_ids, block_tables,
+                 context_lens, rng, temps, seeds, adapters=None,
+                 row_adapter=None):
         # rows inactive at entry must STAY inactive: advancing their ctx/pos
         # would flip them "active" with a zeroed block table and corrupt
         # block 0 of a real sequence
@@ -323,7 +439,9 @@ def build_multi_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config,
         def step(carry, _):
             caches, tok, pos, ctx, rng = carry
             logits, caches = _decode_body(params, caches, tok, pos,
-                                          block_tables, ctx, model_cfg, v2)
+                                          block_tables, ctx, model_cfg, v2,
+                                          adapters=adapters,
+                                          row_adapter=row_adapter)
             rng, step_rng = jax.random.split(rng)
             nxt = sample_rows(logits, temps, step_rng, seeds)
             return (caches, nxt, pos + alive, ctx + alive, rng), nxt
@@ -332,6 +450,18 @@ def build_multi_decode_forward(model_cfg: tfm.TransformerConfig, v2: V2Config,
             step, (caches, token_ids, position_ids, context_lens, rng), None,
             length=num_steps)
         return toks, caches
+
+    if v2.adapter_slots:
+        def fwd(params, caches, token_ids, position_ids, block_tables,
+                context_lens, rng, temps, seeds, adapters, row_adapter):
+            return fwd_body(params, caches, token_ids, position_ids,
+                            block_tables, context_lens, rng, temps, seeds,
+                            adapters=adapters, row_adapter=row_adapter)
+    else:
+        def fwd(params, caches, token_ids, position_ids, block_tables,
+                context_lens, rng, temps, seeds):
+            return fwd_body(params, caches, token_ids, position_ids,
+                            block_tables, context_lens, rng, temps, seeds)
 
     return _memo(("multi_decode", model_cfg, dataclasses.astuple(v2),
                   num_steps),
@@ -356,9 +486,13 @@ def build_cow_copy():
 
 
 def _decode_body(params, caches, token_ids, position_ids, block_tables,
-                 context_lens, model_cfg, v2):
+                 context_lens, model_cfg, v2, adapters=None,
+                 row_adapter=None):
     """Single-token decode shared by build_decode_forward and the multi-step
-    scan (context_lens INCLUDE the current token)."""
+    scan (context_lens INCLUDE the current token).  With ``adapters`` (the
+    stacked per-slot LoRA factors) and ``row_adapter`` (per-row slot
+    vector), each row's attention projections add its adapter's gathered
+    low-rank delta on top of the unchanged base path."""
     from ...ops.pallas.paged_attention import paged_decode_attention
 
     dt = jnp.dtype(v2.dtype)
@@ -379,12 +513,28 @@ def _decode_body(params, caches, token_ids, position_ids, block_tables,
     offsets = position_ids % bs
     nh, nkv, hd = model_cfg.num_heads, model_cfg.kv_heads, model_cfg.head_dim
 
+    xs = (params["layers"], caches["k"], caches["v"])
+    if adapters is not None:
+        xs = xs + (adapters,)
+
     def layer_body(x, inp):
-        lp, k_cache, v_cache = inp
+        if adapters is not None:
+            lp, k_cache, v_cache, ad = inp
+        else:
+            (lp, k_cache, v_cache), ad = inp, {}
         a_in = tfm._norm(x, lp["ln1"], model_cfg.norm, model_cfg.norm_eps)
-        q = tfm._lin(a_in, lp["attn"], "wq", "bq").reshape(S, nh, hd)
-        k = tfm._lin(a_in, lp["attn"], "wk", "bk").reshape(S, nkv, hd)
-        v = tfm._lin(a_in, lp["attn"], "wv", "bv").reshape(S, nkv, hd)
+        q = tfm._lin(a_in, lp["attn"], "wq", "bq")
+        k = tfm._lin(a_in, lp["attn"], "wk", "bk")
+        v = tfm._lin(a_in, lp["attn"], "wv", "bv")
+        if "wq" in ad:
+            q = q + _adapter_proj_delta(a_in, ad["wq"], row_adapter)
+        if "wk" in ad:
+            k = k + _adapter_proj_delta(a_in, ad["wk"], row_adapter)
+        if "wv" in ad:
+            v = v + _adapter_proj_delta(a_in, ad["wv"], row_adapter)
+        q = q.reshape(S, nh, hd)
+        k = k.reshape(S, nkv, hd)
+        v = v.reshape(S, nkv, hd)
         if model_cfg.position == "rope":
             cos = cos_full[position_ids][:, None, :].astype(dt)
             sin = sin_full[position_ids][:, None, :].astype(dt)
@@ -405,7 +555,11 @@ def _decode_body(params, caches, token_ids, position_ids, block_tables,
         v_cache = v_cache.at[blk_ids, offsets].set(v.astype(v_cache.dtype))
         o = paged_decode_attention(q, k_cache, v_cache, block_tables,
                                    context_lens)
-        attn_out = tfm._lin(o.reshape(S, nh * hd), lp["attn"], "wo", "bo")
+        o_flat = o.reshape(S, nh * hd)
+        attn_out = tfm._lin(o_flat, lp["attn"], "wo", "bo")
+        if "wo" in ad:
+            attn_out = attn_out + _adapter_proj_delta(
+                o_flat, ad["wo"], row_adapter)
         m_src = x if model_cfg.parallel_residual else x + attn_out
         m_in = tfm._norm(m_src, lp["ln2"], model_cfg.norm, model_cfg.norm_eps)
         if model_cfg.num_experts > 0:
@@ -418,8 +572,8 @@ def _decode_body(params, caches, token_ids, position_ids, block_tables,
             else (m_src + mlp_out)
         return x, (k_cache, v_cache)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_body, x, (params["layers"], caches["k"], caches["v"]))
+    x, scan_out = jax.lax.scan(layer_body, x, xs)
+    new_k, new_v = scan_out[0], scan_out[1]
     x = tfm._norm(x, params["final_norm"], model_cfg.norm, model_cfg.norm_eps)
     if model_cfg.tie_embeddings:
         logits = x @ params["embed"]["tokens"].astype(dt).T
@@ -463,6 +617,23 @@ class InferenceEngineV2:
             params = quantize_on_host(params, self.cfg.quantize_bits,
                                       self.cfg.quantize_group)
         self.params = params
+        # device adapter stack for multi-tenant LoRA routing (slot 0 is the
+        # reserved all-zero null adapter; serving/adapters.py owns 1..N-1)
+        self.adapter_stack = None
+        if self.cfg.adapter_slots:
+            if self.cfg.adapter_slots < 2:
+                raise ValueError(
+                    "adapter_slots must be >= 2 when enabled (slot 0 is "
+                    "the reserved null adapter)")
+            if self.cfg.adapter_rank <= 0:
+                raise ValueError(
+                    "adapter_slots > 0 requires adapter_rank > 0")
+            if self.cfg.spec_mode == "draft":
+                raise ValueError(
+                    "adapter routing composes with spec_mode='self_draft' "
+                    "only — the separate draft model has no adapter stack "
+                    "to stay consistent with per-row deltas")
+            self.adapter_stack = init_adapter_stack(self.model_cfg, self.cfg)
         # one block reserved as write-scratch for padded tokens
         self.kv = KVCacheManager(self.cfg.num_blocks - 1, self.cfg.block_size,
                                  self.cfg.max_blocks_per_seq)
@@ -591,6 +762,62 @@ class InferenceEngineV2:
             raise RuntimeError("swap_rollback: no previous params retained")
         self.params = prev
         self._prev_params = None
+
+    # -- device adapter stack (serving/adapters.py) ---------------------
+
+    def set_adapter_slot(self, slot: int, pack: Dict[str, Tuple[Any, Any]]
+                         ) -> None:
+        """Load one adapter's stacked factors into device slot ``slot``.
+
+        ``pack`` maps target names (a subset of :data:`ADAPTER_TARGETS`)
+        to ``(lora_a (L, K, r), lora_b (L, r, N))`` host arrays with any
+        scaling already folded into ``lora_b`` and rank padded to
+        ``adapter_rank``.  Targets absent from the pack keep their zeros
+        (exact-zero delta).  Engine-thread only — this is a JAX call."""
+        if self.adapter_stack is None:
+            raise RuntimeError("engine built without adapter_slots")
+        if not (0 < slot < self.cfg.adapter_slots):
+            raise ValueError(
+                f"slot must be in 1..{self.cfg.adapter_slots - 1} "
+                f"(0 is the null adapter), got {slot}")
+        dt = jnp.dtype(self.cfg.dtype)
+        stack = dict(self.adapter_stack)
+        for name, (a, b) in pack.items():
+            if name not in stack:
+                raise ValueError(
+                    f"unsupported adapter target {name!r}; the device "
+                    f"stack carries {sorted(stack)}")
+            tgt = stack[name]
+            want_a = tgt["a"].shape[:1] + tgt["a"].shape[2:]
+            want_b = tgt["b"].shape[:1] + tgt["b"].shape[2:]
+            if tuple(a.shape) != want_a or tuple(b.shape) != want_b:
+                raise ValueError(
+                    f"adapter target {name!r} shape mismatch: got "
+                    f"a{tuple(a.shape)}/b{tuple(b.shape)}, stack wants "
+                    f"a{want_a}/b{want_b}")
+            stack[name] = {
+                "a": tgt["a"].at[:, slot].set(jnp.asarray(a).astype(dt)),
+                "b": tgt["b"].at[:, slot].set(jnp.asarray(b).astype(dt))}
+        self.adapter_stack = stack
+
+    def clear_adapter_slot(self, slot: int) -> None:
+        """Zero a slot's factors (retire/demote) — rows must no longer
+        reference it (the registry's refcounts guarantee that)."""
+        if self.adapter_stack is None:
+            raise RuntimeError("engine built without adapter_slots")
+        if not (0 < slot < self.cfg.adapter_slots):
+            raise ValueError(f"invalid adapter slot {slot}")
+        self.adapter_stack = {
+            name: {"a": tgt["a"].at[:, slot].set(0.0),
+                   "b": tgt["b"].at[:, slot].set(0.0)}
+            for name, tgt in self.adapter_stack.items()}
+
+    def _adapter_args(self) -> tuple:
+        """Extra trailing arguments for the jitted forwards when the
+        adapter stack is on: (stacked factors, per-row slot vector)."""
+        if self.adapter_stack is None:
+            return ()
+        return (self.adapter_stack, jnp.asarray(self.table.adapter))
 
     # -- capacity accessors (serving metrics / admission control) -------
     @property
@@ -859,7 +1086,7 @@ class InferenceEngineV2:
     # -- request API ---------------------------------------------------
     def put(self, prompt_tokens: List[int], max_new_tokens: int = 64,
             strict: bool = False, temperature: Optional[float] = None,
-            seed: int = 0) -> int:
+            seed: int = 0, adapter_slot: int = 0) -> int:
         """Queue a request.  Raises :class:`AdmissionError` if the request
         could NEVER run (exceeds max context).  With ``strict=True`` it also
         raises when the engine cannot admit it RIGHT NOW — no free sequence
@@ -869,7 +1096,18 @@ class InferenceEngineV2:
 
         ``temperature``/``seed`` pin THIS request's sampling row in the
         per-row vector; ``temperature=None`` inherits whatever scalar the
-        caller passes to :meth:`step` (the pre-disaggregation behaviour)."""
+        caller passes to :meth:`step` (the pre-disaggregation behaviour).
+        ``adapter_slot`` selects the device adapter-stack slot this
+        request's rows read (0 = base model, no delta)."""
+        if adapter_slot:
+            if self.adapter_stack is None:
+                raise AdmissionError(
+                    "engine built without adapter_slots; adapter requests "
+                    "cannot run here")
+            if not (0 < adapter_slot < self.cfg.adapter_slots):
+                raise AdmissionError(
+                    f"adapter_slot {adapter_slot} out of range "
+                    f"1..{self.cfg.adapter_slots - 1}")
         max_ctx = self.cfg.max_blocks_per_seq * self.cfg.block_size
         need = len(prompt_tokens) + max_new_tokens
         if need > max_ctx:
@@ -894,13 +1132,31 @@ class InferenceEngineV2:
         self._uid += 1
         seq = SequenceDescriptor(uid=self._uid, tokens=list(prompt_tokens),
                                  max_new_tokens=max_new_tokens,
-                                 temperature=temperature, seed=seed)
+                                 temperature=temperature, seed=seed,
+                                 adapter_slot=adapter_slot)
         self.waiting.append(seq)
         if self.pager is not None and self.cfg.kv_promote_ahead:
             # overlap the disk→host half of any needed promotions with the
-            # steps that run before this request is scheduled
-            self._prefetch_demoted(seq.tokens)
+            # steps that run before the queue head is scheduled
+            self._lookahead_prefetch()
         return self._uid
+
+    def _lookahead_prefetch(self) -> None:
+        """Promote-ahead keyed off the scheduler's ADMISSION lookahead: walk
+        the waiting queue in admission order, bounded by the free sequence
+        slots and the per-step token budget the next `_schedule` will have,
+        and prefetch demoted prefix blocks for exactly the requests that can
+        actually land in the upcoming batch.  Strictly better targeted than
+        prefetching every queued prompt — a deep queue no longer floods the
+        staging thread with promotions the scheduler cannot consume yet."""
+        slots = self.cfg.max_seqs - self.num_running
+        budget = self.cfg.max_tokens_per_step
+        for seq in self.waiting:
+            if slots <= 0 or budget <= 0:
+                break
+            self._prefetch_demoted(seq.tokens)
+            slots -= 1
+            budget -= min(len(seq.tokens), budget)
 
     def _schedule(self) -> List[Tuple[SequenceDescriptor, int]]:
         """Dynamic SplitFuse: decode tokens first, then prefill chunks."""
@@ -1078,7 +1334,7 @@ class InferenceEngineV2:
         toks, self.caches = self._decode_fwd(
             self.params, self.caches, *self._table_inputs(),
             self._row_temps(temperature), self._step_rng(rng),
-            jnp.asarray(t.seed))
+            jnp.asarray(t.seed), *self._adapter_args())
         sampled = np.asarray(toks)
         rows = np.nonzero(t.active)[0]
         sel = sampled[rows].astype(np.int32)[None, :]  # (1, ns)
@@ -1106,7 +1362,7 @@ class InferenceEngineV2:
             emitted, alen, new_hidden, self.caches = self._spec_fwd(
                 self.params, self.spec_heads, self.caches, next_tok, ctx,
                 block_tables, limit, jnp.asarray(self._spec_hidden), rng,
-                temps, seeds)
+                temps, seeds, *self._adapter_args())
             hidden_np = np.asarray(new_hidden)
         else:
             emitted, alen, self.caches, self._draft_caches = self._spec_fwd(
@@ -1204,8 +1460,16 @@ class InferenceEngineV2:
             jnp.asarray(batch.seq_index), jnp.asarray(batch.block_tables),
             jnp.asarray(batch.context_lens), jnp.asarray(batch.logits_rows),
             jnp.asarray(batch.chunk_start), jnp.asarray(batch.chunk_len))
+        ad_args = ()
+        if self.adapter_stack is not None:
+            # batch rows are picks order here (seq_index indexes into the
+            # pick rows, not the SoA table), so build the slot vector fresh
+            row_ad = np.zeros(self.cfg.max_seqs, np.int32)
+            for row, (seq, _) in enumerate(picks):
+                row_ad[row] = seq.adapter_slot
+            ad_args = (self.adapter_stack, jnp.asarray(row_ad))
         logits, hidden, self.caches = self._fwd(
-            self.params, self.caches, *batch_args)
+            self.params, self.caches, *batch_args, *ad_args)
         if self.cfg.spec_mode == "draft":
             # mirror every target KV write into the draft cache (same block
             # tables, its own pool array) so the draft scan can decode from
@@ -1260,7 +1524,7 @@ class InferenceEngineV2:
         toks, self.caches = self._multi_decode[k](
             self.params, self.caches, *self._table_inputs(),
             self._step_rng(rng), self._row_temps(temperature),
-            jnp.asarray(t.seed))
+            jnp.asarray(t.seed), *self._adapter_args())
         toks = np.asarray(toks)  # (k, max_seqs)
         rows = np.nonzero(t.active)[0]
         self._advance_rows(toks[:, rows].astype(np.int32))

@@ -133,6 +133,11 @@ class SequenceDescriptor:
     #: per-request sampling seed — rows with the same seed in one batch
     #: still draw independently (the row index is folded in on device)
     seed: int = 0
+    #: device adapter-stack slot this request's rows read their LoRA
+    #: factors from (serving/adapters.py assigns slots; 0 is the reserved
+    #: null slot whose factors are all-zero, so base-only requests add an
+    #: exact-zero delta and stay bit-identical to an adapterless engine)
+    adapter_slot: int = 0
 
     @property
     def cur_len(self) -> int:
@@ -224,6 +229,8 @@ class DecodeStateTable:
         # scalar temperature" (requests that never set one)
         self.temp = np.full(max_seqs, -1.0, np.float32)
         self.seed = np.zeros(max_seqs, np.int32)
+        # per-row adapter-stack slot (0 = null adapter, exact-zero delta)
+        self.adapter = np.zeros(max_seqs, np.int32)
         self.hist = np.zeros((max_seqs, max_ctx), np.int32)
         self.hist_len = np.zeros(max_seqs, np.int32)
         self.row_of: Dict[int, int] = {}
@@ -242,6 +249,7 @@ class DecodeStateTable:
         self.limit[row] = seq.cur_len + seq.max_new_tokens
         self.temp[row] = -1.0 if seq.temperature is None else seq.temperature
         self.seed[row] = np.int32(np.uint32(seq.seed & 0xFFFFFFFF))
+        self.adapter[row] = seq.adapter_slot
         self.hist_len[row] = 0
         self.sync(seq)
         return row
@@ -276,6 +284,7 @@ class DecodeStateTable:
         self.limit[row] = 0
         self.temp[row] = -1.0
         self.seed[row] = 0
+        self.adapter[row] = 0
         self.hist_len[row] = 0
         self._free.append(row)
 

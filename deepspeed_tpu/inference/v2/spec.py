@@ -53,9 +53,15 @@ def _take_rows(x: jax.Array, idx: jax.Array) -> jax.Array:
 
 
 def verify_body(params, caches, tokens, ctx, block_tables, pos_limit,
-                model_cfg: tfm.TransformerConfig, v2):
+                model_cfg: tfm.TransformerConfig, v2,
+                adapters=None, row_adapter=None):
     """Multi-position decode forward: the target model processes ``Q = k+1``
     consecutive positions per sequence in one pass over the paged KV cache.
+
+    ``adapters``/``row_adapter`` (optional): stacked per-slot LoRA factors
+    and the (S,) per-row slot vector — verification reads the SAME
+    adapter-augmented target the decode path serves, so acceptance is
+    against each tenant's own model (slot 0 rows see a zero delta).
 
     ``tokens`` (S, Q): position ``ctx+j`` gets ``tokens[:, j]``; row ``s`` is
     active iff ``ctx[s] > 0``.  Writes at ``pos >= pos_limit`` park in the
@@ -95,11 +101,25 @@ def verify_body(params, caches, tokens, ctx, block_tables, pos_limit,
     nh, nkv, hd = model_cfg.num_heads, model_cfg.kv_heads, model_cfg.head_dim
 
     def layer_body(x, inp):
-        lp, k_cache, v_cache = inp
+        if adapters is not None:
+            lp, k_cache, v_cache, ad = inp
+        else:
+            (lp, k_cache, v_cache), ad = inp, {}
+        from .engine import _adapter_proj_delta
+
         a_in = tfm._norm(x, lp["ln1"], model_cfg.norm, model_cfg.norm_eps)
-        q = tfm._lin(a_in, lp["attn"], "wq", "bq").reshape(S, Q, nh, hd)
-        k = tfm._lin(a_in, lp["attn"], "wk", "bk").reshape(S, Q, nkv, hd)
-        v = tfm._lin(a_in, lp["attn"], "wv", "bv").reshape(S, Q, nkv, hd)
+        q = tfm._lin(a_in, lp["attn"], "wq", "bq")
+        k = tfm._lin(a_in, lp["attn"], "wk", "bk")
+        v = tfm._lin(a_in, lp["attn"], "wv", "bv")
+        if "wq" in ad:
+            q = q + _adapter_proj_delta(a_in, ad["wq"], row_adapter)
+        if "wk" in ad:
+            k = k + _adapter_proj_delta(a_in, ad["wk"], row_adapter)
+        if "wv" in ad:
+            v = v + _adapter_proj_delta(a_in, ad["wv"], row_adapter)
+        q = q.reshape(S, Q, nh, hd)
+        k = k.reshape(S, Q, nkv, hd)
+        v = v.reshape(S, Q, nkv, hd)
         if model_cfg.position == "rope":
             cos = cos_full[pos][:, :, None, :].astype(dt)
             sin = sin_full[pos][:, :, None, :].astype(dt)
@@ -120,7 +140,11 @@ def verify_body(params, caches, tokens, ctx, block_tables, pos_limit,
         v_cache = v_cache.at[blk_ids, offsets].set(v.astype(v_cache.dtype))
         o = paged_prefill_attention(q, k_cache, v_cache, block_tables,
                                     ctx * active, chunk_len)
-        attn_out = tfm._lin(o.reshape(S, Q, nh * hd), lp["attn"], "wo", "bo")
+        o_flat = o.reshape(S, Q, nh * hd)
+        attn_out = tfm._lin(o_flat, lp["attn"], "wo", "bo")
+        if "wo" in ad:
+            attn_out = attn_out + _adapter_proj_delta(
+                o_flat, ad["wo"], row_adapter)
         m_src = x if model_cfg.parallel_residual else x + attn_out
         m_in = tfm._norm(m_src, lp["ln2"], model_cfg.norm, model_cfg.norm_eps)
         if model_cfg.num_experts > 0:
@@ -133,8 +157,10 @@ def verify_body(params, caches, tokens, ctx, block_tables, pos_limit,
             else (m_src + mlp_out)
         return x, (k_cache, v_cache)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_body, x, (params["layers"], caches["k"], caches["v"]))
+    xs = (params["layers"], caches["k"], caches["v"])
+    if adapters is not None:
+        xs = xs + (adapters,)
+    x, (new_k, new_v) = jax.lax.scan(layer_body, x, xs)
     x = tfm._norm(x, params["final_norm"], model_cfg.norm, model_cfg.norm_eps)
     if model_cfg.tie_embeddings:
         logits = x @ params["embed"]["tokens"].astype(dt).T
@@ -227,8 +253,9 @@ def build_self_draft_step(model_cfg: tfm.TransformerConfig, v2):
     """
     from ...linear.spec_heads import apply_spec_heads
 
-    def spec_step(params, heads, caches, next_tok, ctx, block_tables,
-                  pos_limit, last_hidden, rng, temps, seeds):
+    def spec_body(params, heads, caches, next_tok, ctx, block_tables,
+                  pos_limit, last_hidden, rng, temps, seeds,
+                  adapters=None, row_adapter=None):
         from .engine import _row_keys
 
         head_logits = apply_spec_heads(heads, last_hidden)  # (S, k, V) f32
@@ -240,12 +267,29 @@ def build_self_draft_step(model_cfg: tfm.TransformerConfig, v2):
         draft = jnp.where((temps > 0.0)[:, None], cat,
                           head_logits.argmax(-1).astype(jnp.int32))
         tokens = jnp.concatenate([next_tok[:, None], draft], axis=1)
+        # the heads propose adapter-less; verification runs the adapter-
+        # augmented target, so greedy rows still emit the (per-tenant)
+        # target argmax — identity holds, only acceptance rate moves
         logits, hidden, caches = verify_body(
             params, caches, tokens, ctx, block_tables, pos_limit,
-            model_cfg, v2)
+            model_cfg, v2, adapters=adapters, row_adapter=row_adapter)
         emitted, a = _accept_and_emit(logits, draft, q, v_rng, temps, seeds)
         new_hidden = _take_rows(hidden, a).astype(jnp.float32)  # (S, H)
         return emitted, a, new_hidden, caches
+
+    if v2.adapter_slots:
+        def spec_step(params, heads, caches, next_tok, ctx, block_tables,
+                      pos_limit, last_hidden, rng, temps, seeds,
+                      adapters, row_adapter):
+            return spec_body(params, heads, caches, next_tok, ctx,
+                             block_tables, pos_limit, last_hidden, rng,
+                             temps, seeds, adapters, row_adapter)
+    else:
+        def spec_step(params, heads, caches, next_tok, ctx, block_tables,
+                      pos_limit, last_hidden, rng, temps, seeds):
+            return spec_body(params, heads, caches, next_tok, ctx,
+                             block_tables, pos_limit, last_hidden, rng,
+                             temps, seeds)
 
     from .engine import _memo
 

@@ -409,6 +409,47 @@ def expand_axes_for_lora(axes, params, base_weight_sharding: int = 1):
     return out
 
 
+def graft_adapter_pack(params, pack, scaling: float = 1.0):
+    """Wrap targeted projections of a plain parameter tree with the factors
+    of a serving adapter pack — ``{target: (a (L, K, r), b (L, r, N))}``,
+    the format :func:`deepspeed_tpu.serving.adapters.load_adapter_pack`
+    produces (registry packs already fold the LoRA scaling into ``b``, so
+    pass ``scaling=1.0`` for those).  The grafted tree feeds straight into
+    :func:`merge_lora_weights`: that pair is how a registry adapter becomes
+    an exportable merged checkpoint without ever having trained here."""
+    pack = dict(pack)
+    found = set()
+
+    def walk(p):
+        if not isinstance(p, dict):
+            return p
+        out = {}
+        for k, v in p.items():
+            if isinstance(v, dict):
+                out[k] = walk(v)
+            elif k in pack and hasattr(v, "ndim") and v.ndim >= 2 \
+                    and not isinstance(v, (LoRAWeight, QuantizedBaseWeight)):
+                a, b = pack[k]
+                if tuple(v.shape) != (a.shape[0], a.shape[1], b.shape[2]):
+                    raise ValueError(
+                        f"adapter pack target {k!r} wants a weight of shape "
+                        f"{(a.shape[0], a.shape[1], b.shape[2])}, tree has "
+                        f"{tuple(v.shape)}")
+                found.add(k)
+                out[k] = LoRAWeight(v, jnp.asarray(a), jnp.asarray(b),
+                                    float(scaling))
+            else:
+                out[k] = v
+        return out
+
+    grafted = walk(params)
+    missing = set(pack) - found
+    if missing:
+        raise ValueError(f"adapter pack targets {sorted(missing)} not found "
+                         "in the parameter tree")
+    return grafted
+
+
 def has_lora(tree) -> bool:
     leaves = jax.tree_util.tree_leaves(tree, is_leaf=_is_lora)
     return any(isinstance(l, LoRAWeight) for l in leaves)

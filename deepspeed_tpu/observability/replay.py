@@ -79,7 +79,7 @@ WORKLOAD_VERSION = 1
 _RECORD_KEYS = {
     "offset_s", "prompt", "max_new_tokens", "stop_token_ids",
     "deadline_s", "cancel_after_s", "rid", "template",
-    "temperature", "tenant", "slo_class",
+    "temperature", "tenant", "slo_class", "adapter",
 }
 
 
@@ -105,6 +105,7 @@ class WorkloadRequest:
     temperature: Optional[float] = None
     tenant: Optional[str] = None
     slo_class: Optional[str] = None
+    adapter: Optional[str] = None
 
     def to_record(self) -> Dict[str, Any]:
         rec: Dict[str, Any] = {"offset_s": round(self.offset_s, 6),
@@ -127,6 +128,8 @@ class WorkloadRequest:
             rec["tenant"] = self.tenant
         if self.slo_class is not None:
             rec["slo_class"] = self.slo_class
+        if self.adapter is not None:
+            rec["adapter"] = self.adapter
         return rec
 
     @classmethod
@@ -154,7 +157,7 @@ class WorkloadRequest:
             cancel_after_s=rec.get("cancel_after_s"),
             rid=rec.get("rid"), template=rec.get("template"),
             temperature=rec.get("temperature"), tenant=rec.get("tenant"),
-            slo_class=rec.get("slo_class"))
+            slo_class=rec.get("slo_class"), adapter=rec.get("adapter"))
 
 
 # ---------------------------------------------------------------------------
@@ -240,7 +243,8 @@ class WorkloadCapture:
                      deadline_s: Optional[float],
                      temperature: Optional[float] = None,
                      tenant: Optional[str] = None,
-                     slo_class: Optional[str] = None) -> None:
+                     slo_class: Optional[str] = None,
+                     adapter: Optional[str] = None) -> None:
         with self._lock:
             if rid in self._by_rid:
                 return  # failover resubmit of a captured request
@@ -253,7 +257,7 @@ class WorkloadCapture:
                 "stop_token_ids": tuple(int(x) for x in stop_token_ids),
                 "deadline_s": deadline_s, "cancel_after_s": None,
                 "temperature": temperature, "tenant": tenant,
-                "slo_class": slo_class,
+                "slo_class": slo_class, "adapter": adapter,
             }
             self._order.append(rid)
 
@@ -278,7 +282,7 @@ class WorkloadCapture:
                 deadline_s=rec["deadline_s"],
                 cancel_after_s=rec["cancel_after_s"], rid=rid,
                 temperature=rec["temperature"], tenant=rec["tenant"],
-                slo_class=rec["slo_class"])
+                slo_class=rec["slo_class"], adapter=rec["adapter"])
                 for rid in self._order
                 for rec in (self._by_rid[rid],)]
 
@@ -310,7 +314,8 @@ def note_submit(rid: str, t: float, prompt: Sequence[int],
                 deadline_s: Optional[float],
                 temperature: Optional[float] = None,
                 tenant: Optional[str] = None,
-                slo_class: Optional[str] = None) -> None:
+                slo_class: Optional[str] = None,
+                adapter: Optional[str] = None) -> None:
     """Broker hook: record a submit into the installed capture (no-op —
     one dict lookup — when no capture is running)."""
     cap = _capture
@@ -319,7 +324,7 @@ def note_submit(rid: str, t: float, prompt: Sequence[int],
             cap._note_submit(rid, t, prompt, max_new_tokens,
                              stop_token_ids, deadline_s,
                              temperature=temperature, tenant=tenant,
-                             slo_class=slo_class)
+                             slo_class=slo_class, adapter=adapter)
         except Exception:  # noqa: BLE001 — must never break the submit path
             pass
 
@@ -352,7 +357,10 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
                         sampled_fraction: float = 0.0,
                         sampled_temperature: float = 0.7,
                         resume_fraction: float = 0.0,
-                        idle_gap_s: float = 0.0
+                        idle_gap_s: float = 0.0,
+                        adapters: int = 0,
+                        adapter_zipf_a: float = 1.2,
+                        adapter_base_fraction: float = 0.0
                         ) -> Tuple[Dict[str, Any], List[WorkloadRequest]]:
     """Seeded synthetic workload with production-shaped structure:
 
@@ -376,6 +384,11 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
       first wave's prefixes go cold during the gap (demoted under
       pressure), and the resume wave's hit rate measures whether
       demote-instead-of-evict kept those sessions resident.
+    * optional **multi-adapter population** — ``adapters > 0`` assigns
+      every request a bounded-Zipf-popular ``adapter{i}`` label (rank-1
+      hot tenants dominate, a long tail stays cold — the S-LoRA paging
+      shape), except a seeded ``adapter_base_fraction`` that stays on the
+      shared base model (``adapter=None``).
 
     Deterministic: same arguments → identical workload.
     """
@@ -441,6 +454,19 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
                 max_new_tokens=int(rbudgets[j]),
                 deadline_s=deadline_s,
                 template=parent.template))
+    # multi-adapter population (again all rng draws AFTER every prior
+    # wave's, so adapters=0 reproduces historical workloads byte-
+    # identically).  Popularity is bounded-Zipf over adapter rank, same
+    # construction as the template reuse above.
+    if adapters > 0:
+        aranks = np.arange(1, adapters + 1, dtype=float)
+        aweights = aranks ** (-adapter_zipf_a)
+        aweights /= aweights.sum()
+        apicks = rng.choice(adapters, size=len(requests), p=aweights)
+        base_mask = rng.random(len(requests)) < adapter_base_fraction
+        for i, req in enumerate(requests):
+            if not base_mask[i]:
+                req.adapter = f"adapter{int(apicks[i])}"
     meta = {"source": "synthetic", "seed": seed,
             "requests": num_requests, "mean_rate_rps": mean_rate_rps,
             "gamma_shape": gamma_shape, "num_templates": num_templates,
@@ -449,7 +475,9 @@ def synthesize_workload(seed: int = 0, num_requests: int = 32,
             "max_new_tokens": max_new_tokens,
             "cancel_fraction": cancel_fraction, "tenants": tenants,
             "sampled_fraction": sampled_fraction,
-            "resume_fraction": resume_fraction, "idle_gap_s": idle_gap_s}
+            "resume_fraction": resume_fraction, "idle_gap_s": idle_gap_s,
+            "adapters": adapters, "adapter_zipf_a": adapter_zipf_a,
+            "adapter_base_fraction": adapter_base_fraction}
     return meta, requests
 
 
@@ -597,12 +625,15 @@ def replay_workload(pool, workload: Sequence[WorkloadRequest],
                 time.sleep(delay)
             submit_t = time.monotonic()
             try:
+                # adapter only when labeled, so adapter-free workloads
+                # keep working against pools without adapter support
+                extra = {"adapter": r.adapter} if r.adapter else {}
                 handle = pool.submit(
                     r.prompt, max_new_tokens=r.max_new_tokens,
                     deadline_s=r.deadline_s,
                     stop_token_ids=r.stop_token_ids,
                     temperature=r.temperature,
-                    tenant=r.tenant, slo_class=r.slo_class)
+                    tenant=r.tenant, slo_class=r.slo_class, **extra)
             except Exception as e:  # noqa: BLE001 — QueueFull/NoReplica
                 results[i] = {
                     "index": i, "rid": None,
@@ -730,6 +761,11 @@ _SLO_KEYS = {
     # across the idle gap, promote latency, and the leak gate
     "min_hit_rate_under_pressure", "min_hit_rate_gain",
     "min_sessions_resident", "max_promote_ms_p95", "max_leaked_blocks",
+    # multi-adapter serving scenario (bench --mode adapters): mixed-batch
+    # token identity vs dedicated single-adapter engines, adapter promote
+    # latency, device residency ceiling, and the registry leak gate
+    "max_token_mismatches", "max_adapter_promote_ms_p95",
+    "max_resident_adapters", "max_leaked_adapters", "min_adapter_hit_rate",
 }
 
 

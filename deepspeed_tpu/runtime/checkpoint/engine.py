@@ -798,18 +798,49 @@ def _load_native(engine, ckpt_dir: str, load_optimizer_states: bool
 
 
 def export_merged_weights(engine, save_dir: str,
-                          tag: str = "merged") -> str:
+                          tag: str = "merged",
+                          adapter_id: Optional[str] = None,
+                          adapters: Any = None) -> str:
     """Fold every LoRA adapter into its (dequantized) base weight and write
     the result as a plain full-model safetensors file — the serving artifact
     (reference: PEFT ``merge_and_unload`` → ``save_pretrained``).  The
     exported tree has the SAME structure as a never-LoRA'd model, so
     ``inference.engine.InferenceEngine`` (and any full-checkpoint tooling)
-    consumes it directly via ``load_merged_params``."""
-    from ...linear.optimized_linear import has_lora, merge_lora_weights
+    consumes it directly via ``load_merged_params``.
 
-    if not has_lora(engine.state.params):
-        raise ValueError("export_merged_weights: engine has no LoRA adapters")
-    host_params = _full_host_tree(engine.state.params)
+    Two sources of adapters:
+
+    * default — the training engine's own LoRA nodes (``engine.state.params``
+      after a PEFT run);
+    * ``adapter_id`` + ``adapters`` — a serving
+      :class:`~deepspeed_tpu.serving.adapters.AdapterRegistry` adapter: its
+      pack is grafted onto the engine's plain parameter tree and merged,
+      so any hot-registered tenant can be exported as a standalone merged
+      checkpoint without a training run.  ``engine`` may be the training
+      engine or the registry's own ``InferenceEngineV2`` (anything with
+      ``state.params`` or ``params``); registry packs carry scaling folded
+      into ``lora_b``, so the graft uses ``scaling=1.0``."""
+    from ...linear.optimized_linear import (graft_adapter_pack, has_lora,
+                                            merge_lora_weights)
+
+    params = getattr(getattr(engine, "state", None), "params", None)
+    if params is None:
+        params = getattr(engine, "params", None)
+    if params is None:
+        raise ValueError("export_merged_weights: engine has neither "
+                         "state.params nor params")
+    if adapter_id is not None:
+        if adapters is None:
+            raise ValueError("export_merged_weights: adapter_id needs the "
+                             "AdapterRegistry in `adapters`")
+        pack = adapters.get_pack(adapter_id)
+        host_params = graft_adapter_pack(_full_host_tree(params), pack,
+                                         scaling=1.0)
+    else:
+        if not has_lora(params):
+            raise ValueError(
+                "export_merged_weights: engine has no LoRA adapters")
+        host_params = _full_host_tree(params)
     merged = merge_lora_weights(host_params)
     out_dir = os.path.join(save_dir, tag)
     if jax.process_index() == 0:
@@ -818,6 +849,7 @@ def export_merged_weights(engine, save_dir: str,
             _save_tree(merged, os.path.join(out_dir, "model.safetensors"))
             with open(os.path.join(out_dir, "engine_state.json"), "w") as f:
                 json.dump({"merged_lora": True,
+                           "merged_adapter_id": adapter_id,
                            "framework_version": _version()}, f, indent=2)
         log_dist(f"exported merged LoRA weights -> {out_dir}")
     if jax.process_count() > 1:

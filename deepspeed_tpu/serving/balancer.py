@@ -190,7 +190,7 @@ class ReplicaPool:
         #: routing-decision ledger: requests routed per phase, plus how
         #: often cache-aware routing found a replica with a warm prefix
         self.route_stats: Dict[str, int] = {
-            "prefill": 0, "decode": 0, "cache_hits": 0}
+            "prefill": 0, "decode": 0, "cache_hits": 0, "adapter_hits": 0}
         self.supervisor = None
         if any(isinstance(t, FramedReplica) for t in self.replicas):
             from .supervisor import ReplicaSupervisor
@@ -204,14 +204,22 @@ class ReplicaPool:
     def build(cls, engine_factory: Callable[[], "object"],
               config: ServingConfig,
               metrics: Optional[ServingMetrics] = None,
-              monitor: Optional[Monitor] = None) -> "ReplicaPool":
+              monitor: Optional[Monitor] = None,
+              adapter_factory: Optional[Callable] = None) -> "ReplicaPool":
         """In-process pool: ``config.num_replicas`` brokers from an engine
         factory (each call must return a FRESH InferenceEngineV2 over
-        shared params)."""
+        shared params).  ``adapter_factory(engine, name)`` builds each
+        replica's :class:`~deepspeed_tpu.serving.adapters.AdapterRegistry`
+        (None = the deployment serves no adapters)."""
         metrics = metrics or ServingMetrics()
-        brokers = [RequestBroker(engine_factory(), config, metrics=metrics,
-                                 name=f"replica{i}", own_gauges=False)
-                   for i in range(config.num_replicas)]
+        brokers = []
+        for i in range(config.num_replicas):
+            engine = engine_factory()
+            adapters = (adapter_factory(engine, f"replica{i}")
+                        if adapter_factory is not None else None)
+            brokers.append(RequestBroker(engine, config, metrics=metrics,
+                                         name=f"replica{i}",
+                                         own_gauges=False, adapters=adapters))
         return cls(brokers, config, metrics=metrics, monitor=monitor)
 
     @classmethod
@@ -550,15 +558,32 @@ class ReplicaPool:
             n += 1
         return n
 
+    def _adapter_score(self, i: int, adapter: str) -> int:
+        """Adapter-residency score of replica ``i`` for ``adapter`` from
+        its heartbeated registry summary: device-resident (2) beats
+        registered-but-paged-out (1) beats unknown (0).  Never raises —
+        an unreachable replica scores 0."""
+        try:
+            s = self.replicas[i].adapter_summary()
+        except Exception:  # noqa: BLE001 — routing must not die with a replica
+            return 0
+        if adapter in (s.get("resident") or ()):
+            return 2
+        if adapter in (s.get("registered") or ()):
+            return 1
+        return 0
+
     def _pick(self, exclude: Sequence[int] = (),
               phase: Optional[str] = None,
-              prompt: Optional[Sequence[int]] = None) -> int:
+              prompt: Optional[Sequence[int]] = None,
+              adapter: Optional[str] = None) -> int:
         healthy = [i for i in self.healthy_replicas()
                    if i not in exclude
                    and self.replicas[i].name not in self._quiesced]
         if not healthy:
             raise NoReplicaError("no healthy replica")
         cache_hit = False
+        adapter_hit = False
         if phase is not None:
             # prefer the exact class, then "mixed"; an all-wrong-class
             # pool still serves (degraded placement beats a 503)
@@ -567,6 +592,16 @@ class ReplicaPool:
             compat = exact or [i for i in healthy
                                if self.replicas[i].replica_class == "mixed"]
             healthy = compat or healthy
+        if adapter is not None and len(healthy) > 1:
+            # adapter-aware: a replica with the adapter device-resident
+            # skips the promote entirely; one that at least knows it skips
+            # the checkpoint load.  Applied before prefix overlap — a slot
+            # re-load costs more than a prefill replay.
+            scores = {i: self._adapter_score(i, adapter) for i in healthy}
+            best = max(scores.values())
+            if best > 0:
+                healthy = [i for i in healthy if scores[i] == best]
+                adapter_hit = best == 2
         if prompt is not None and self.cfg.cache_aware_routing \
                 and len(healthy) > 1:
             # cache-aware: the replica whose radix tree already holds the
@@ -583,6 +618,8 @@ class ReplicaPool:
                 self.route_stats[phase] = self.route_stats.get(phase, 0) + 1
             if cache_hit:
                 self.route_stats["cache_hits"] += 1
+            if adapter_hit:
+                self.route_stats["adapter_hits"] += 1
         # least outstanding tokens; stable round-robin among ties
         return min(healthy,
                    key=lambda i: (self.replicas[i].outstanding_tokens(),
@@ -618,7 +655,8 @@ class ReplicaPool:
                                     kwargs.get("max_new_tokens"))
         while True:
             try:
-                idx = self._pick(exclude=tried, phase=phase, prompt=prompt)
+                idx = self._pick(exclude=tried, phase=phase, prompt=prompt,
+                                 adapter=kwargs.get("adapter"))
             except NoReplicaError:
                 if isinstance(last, QueueFullError):
                     raise last
@@ -655,6 +693,7 @@ class ReplicaPool:
                 "kv_utilization": round(t.kv_utilization(), 4),
                 "prefix": t.prefix_stats(),
                 "spec": t.spec_stats(),
+                "adapters": t.adapter_stats(),
                 "stale": False,
             }
             entry.update(t.describe())
@@ -710,6 +749,21 @@ class ReplicaPool:
                                   if proposed else 0.0)
         return agg
 
+    def _aggregate_adapter_stats(self) -> Dict[str, float]:
+        """Sum adapter-registry stats over replicas; ``promote_wait_ms``
+        (a p95, not a count) is reported as the fleet max, the honest
+        tail for a latency gauge."""
+        agg: Dict[str, float] = {}
+        waits: List[float] = []
+        for t in self.replicas:
+            for k, v in t.adapter_stats().items():
+                if k == "promote_wait_ms":
+                    waits.append(float(v))
+                else:
+                    agg[k] = agg.get(k, 0.0) + v
+        agg["promote_wait_ms"] = max(waits) if waits else 0.0
+        return agg
+
     def _update_gauges(self) -> None:
         running = sum(t.num_running() for t in self.replicas)
         kv = [t.kv_utilization() for t in self.replicas if t.healthy()]
@@ -717,6 +771,7 @@ class ReplicaPool:
                                 sum(kv) / len(kv) if kv else 0.0)
         self.metrics.set_prefix_stats(self._aggregate_prefix_stats())
         self.metrics.set_spec_stats(self._aggregate_spec_stats())
+        self.metrics.set_adapter_stats(self._aggregate_adapter_stats())
         # a dead replica's stats accessors return last-known (frozen)
         # values: mark its gauge series stale so dashboards can tell
         # frozen-but-reported from live (ISSUE 13 satellite)

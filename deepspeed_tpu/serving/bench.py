@@ -869,6 +869,215 @@ def run_paging_replay(seed: int = 0, requests: int = 24,
     }
 
 
+# -- multi-tenant adapter serving (ISSUE 19) -------------------------------
+
+
+def run_adapter_bench(seed: int = 0, requests: int = 32,
+                      rate_rps: float = 8.0,
+                      num_adapters: int = 5, adapter_slots: int = 4,
+                      adapter_rank: int = 4,
+                      adapter_base_fraction: float = 0.25,
+                      time_scale: float = 1.0,
+                      slo_path: Optional[str] = None,
+                      slo_workload: str = "adapters-smoke",
+                      model: str = "tiny", max_queue: int = 64) -> dict:
+    """Multi-tenant adapter serving A/B (``--mode adapters``).
+
+    One seeded Zipf-popular ``num_adapters``-adapter workload (long-tail
+    tenants over one shared base, a seeded fraction staying on the base)
+    replayed against a single mixed-adapter replica whose registry has
+    MORE adapters registered than device slots — so the run must page
+    (resident count bounded by ``adapter_slots - 1``) and must not leak a
+    ref after drain.  Every request's greedy token stream is then compared
+    against a dedicated **always-merged** engine for its adapter — the
+    deployment you'd run without multi-adapter serving: one engine per
+    tenant with the adapter folded into the weights
+    (``graft_adapter_pack`` + ``merge_lora_weights``, the registry-pack
+    export path) — and base-labeled requests against the plain base
+    engine.  ``token_mismatches`` counts requests whose streams differ;
+    the ``adapters-smoke`` SLO table gates it at zero alongside promote
+    p95, resident-adapter count, hit rate, and the leak check.
+    """
+    import argparse
+    import dataclasses as _dc
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from ..inference.v2.engine import (InferenceEngineV2,
+                                       adapter_target_shapes)
+    from ..linear.optimized_linear import (graft_adapter_pack,
+                                           merge_lora_weights)
+    from ..models import transformer as tfm
+    from ..observability import replay as rp
+    from .adapters import load_adapter_pack, publish_adapter
+    from .balancer import ReplicaPool
+    from .config import ServingConfig
+    from .server import (add_engine_cli_args, add_serving_cli_args,
+                         build_adapter_factory, build_engine_factory)
+
+    meta, wl = rp.synthesize_workload(
+        seed=seed, num_requests=requests, mean_rate_rps=rate_rps,
+        max_new_tokens=8, adapters=num_adapters,
+        adapter_base_fraction=adapter_base_fraction)
+    slos = rp.load_slos(slo_path)
+    if slo_workload not in slos:
+        raise rp.SLOError(f"no [workloads.\"{slo_workload}\"] table in "
+                          f"{slo_path or rp.default_slo_path()}; have "
+                          f"{sorted(slos)}")
+
+    # publish one adapter-only checkpoint per tenant — random factors big
+    # enough (0.5-ish deltas) that each adapter's greedy continuations
+    # demonstrably differ from the base's, with the LoRA scaling carried
+    # by the manifest exactly as a PEFT training run would leave it
+    model_cfg = tfm.get_config(model, dtype="bfloat16")
+    shapes = adapter_target_shapes(model_cfg)
+    L = model_cfg.num_layers
+    store = tempfile.mkdtemp(prefix="dstpu-adapter-bench-")
+    ckpts = {}
+    for i in range(num_adapters):
+        arng = np.random.default_rng(seed * 1000 + 17 + i)
+        tree = {}
+        for target, (K, N) in shapes.items():
+            tree[target] = {
+                "lora_a": (arng.standard_normal((L, K, adapter_rank))
+                           / np.sqrt(K)).astype(np.float32),
+                "lora_b": arng.standard_normal(
+                    (L, adapter_rank, N)).astype(np.float32),
+            }
+        aid = f"adapter{i}"
+        ckpts[aid] = publish_adapter(tree, store, aid, scaling=0.5)
+
+    geometry = ["--model", model, "--seed", "0", "--num_blocks", "64",
+                "--max_tokens_per_step", "32", "--max_seqs", "4",
+                "--block_size", "8", "--max_blocks_per_seq", "8",
+                "--max_queue", str(max_queue)]
+
+    def parse(argv):
+        ep = argparse.ArgumentParser()
+        add_engine_cli_args(ep)
+        add_serving_cli_args(ep)
+        return ep.parse_args(argv)
+
+    def _wait_idle(pool, budget_s: float = 60.0) -> None:
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            if sum(t.num_running() for t in pool.replicas
+                   if t.healthy()) == 0 and pool.queue_depth() == 0:
+                return
+            time.sleep(0.2)
+
+    # -- mixed-adapter leg: ONE replica, every tenant --------------------
+    eargs = parse(geometry + [
+        "--adapter_slots", str(adapter_slots),
+        "--adapter_rank", str(adapter_rank),
+        "--adapter_host_pool_mb", "64",
+        "--adapter_preload",
+        ",".join(f"{aid}={d}" for aid, d in sorted(ckpts.items()))])
+    cfg = ServingConfig(max_queue=max_queue, num_replicas=1,
+                        replica_transport="inprocess",
+                        submit_timeout_s=120.0)
+    pool = ReplicaPool.build(build_engine_factory(eargs), cfg,
+                             adapter_factory=build_adapter_factory(eargs))
+    pool.start()
+    pool.wait_ready()
+    try:
+        pool.submit([1, 2, 3], max_new_tokens=2).result(timeout=300)
+        out = rp.replay_workload(pool, wl, time_scale=time_scale)
+        _wait_idle(pool)
+        reg = pool.replicas[0].broker.adapters
+        stats = reg.stats()
+        promote_ms = reg.promote_wait_percentiles()
+        summary_after = reg.summary()
+        try:
+            reg.check_leaks()
+            leak_check_ok = True
+        except AssertionError:
+            leak_check_ok = False
+        route_stats = dict(pool.route_stats)
+    finally:
+        pool.drain()
+
+    # -- dedicated always-merged engines ---------------------------------
+    # one engine per tenant, built from the SAME flag set as the mixed
+    # replica minus the adapter machinery, so the base geometry (and its
+    # compiled decode program) is what an adapter-free deployment runs
+    base_params = tfm.init_params(jax.random.PRNGKey(0), model_cfg)
+    base_eng = build_engine_factory(parse(list(geometry)))()
+    v2_plain = base_eng.cfg
+
+    def dedicated_tokens(adapter_id, reqs) -> dict:
+        if adapter_id is None:
+            eng = base_eng
+        else:
+            pack = load_adapter_pack(ckpts[adapter_id], model_cfg,
+                                     adapter_rank)
+            params = merge_lora_weights(
+                graft_adapter_pack(base_params, pack, scaling=1.0))
+            eng = InferenceEngineV2(model_cfg, params, v2_plain)
+        dpool = ReplicaPool.build(lambda: eng, _dc.replace(cfg))
+        dpool.start()
+        dpool.wait_ready()
+        try:
+            toks = {}
+            for i, r in reqs:
+                h = dpool.submit(r.prompt,
+                                 max_new_tokens=r.max_new_tokens)
+                toks[i] = [int(t) for t in h.tokens(timeout=300)]
+        finally:
+            dpool.drain()
+        return toks
+
+    by_adapter: dict = {}
+    for i, r in enumerate(wl):
+        by_adapter.setdefault(r.adapter, []).append((i, r))
+    mismatches = []
+    for adapter_id, reqs in sorted(by_adapter.items(),
+                                   key=lambda kv: kv[0] or ""):
+        oracle = dedicated_tokens(adapter_id, reqs)
+        for i, _ in reqs:
+            if out["requests"][i]["tokens"] != oracle[i]:
+                mismatches.append({
+                    "index": i, "adapter": adapter_id,
+                    "mixed": out["requests"][i]["tokens"],
+                    "dedicated": oracle[i]})
+    shutil.rmtree(store, ignore_errors=True)
+
+    hits, loads = stats["hits"], stats["loads"]
+    summary = dict(out["summary"])
+    summary["token_mismatches"] = len(mismatches)
+    summary["adapter_promote_ms_p95"] = promote_ms["p95"]
+    summary["resident_adapters"] = int(stats["resident"])
+    summary["leaked_adapters"] = (int(stats["refs"])
+                                  + (0 if leak_check_ok else 1))
+    summary["adapter_hit_rate"] = round(
+        hits / (hits + loads), 6) if (hits + loads) else 0.0
+    violations = rp.check_slo(summary, slos[slo_workload], slo_workload)
+    return {
+        "subject": f"{model} model, JAX_PLATFORMS=cpu, {num_adapters} "
+                   f"Zipf-popular adapters over {adapter_slots - 1} device "
+                   "slots on 1 replica, greedy streams A/B'd per-request "
+                   "against dedicated always-merged single-adapter engines",
+        "workload_meta": meta,
+        "slo_workload": slo_workload,
+        "summary": summary,
+        "token_mismatches": mismatches[:8],
+        "adapter_requests": {a or "base": len(reqs)
+                             for a, reqs in sorted(
+                                 by_adapter.items(),
+                                 key=lambda kv: kv[0] or "")},
+        "registry_stats_after": {k: round(float(v), 4)
+                                 for k, v in stats.items()},
+        "registry_summary_after": summary_after,
+        "promote_ms": promote_ms,
+        "route_stats": route_stats,
+        "leak_check_ok": leak_check_ok,
+        "slo_violations": [v.to_dict() for v in violations],
+    }
+
+
 # -- mixed-GEMM kernel microbench ------------------------------------------
 
 
@@ -975,7 +1184,8 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=None,
                    help="merge results into this BENCH_EVIDENCE.json")
     p.add_argument("--mode",
-                   choices=["serving", "prefix", "spec", "gemm", "replay"],
+                   choices=["serving", "prefix", "spec", "gemm", "replay",
+                            "adapters"],
                    default="serving")
     p.add_argument("--rates", default="2,8,24")
     p.add_argument("--duration_s", type=float, default=8.0)
@@ -1049,10 +1259,33 @@ def main(argv=None) -> int:
     p.add_argument("--kv_spill_dir", default="",
                    help="replay --paging: also exercise the disk spill "
                         "tier (safetensors files in this directory)")
+    p.add_argument("--num_adapters", type=int, default=5,
+                   help="adapters: distinct Zipf-popular adapters in the "
+                        "synthesized workload")
+    p.add_argument("--adapter_slots", type=int, default=4,
+                   help="adapters: device adapter slots (incl. the null "
+                        "slot) — fewer usable slots than adapters forces "
+                        "paging")
+    p.add_argument("--adapter_rank", type=int, default=4,
+                   help="adapters: LoRA rank of the published adapters")
+    p.add_argument("--adapter_base_fraction", type=float, default=0.25,
+                   help="adapters: fraction of requests staying on the "
+                        "shared base model")
     args = p.parse_args(argv)
 
     rates = [float(r) for r in args.rates.split(",")]
-    if args.mode == "replay" and args.paging:
+    if args.mode == "adapters":
+        result = run_adapter_bench(
+            seed=args.seed, requests=args.requests, rate_rps=rates[0],
+            num_adapters=args.num_adapters,
+            adapter_slots=args.adapter_slots,
+            adapter_rank=args.adapter_rank,
+            adapter_base_fraction=args.adapter_base_fraction,
+            time_scale=args.time_scale, slo_path=args.slo,
+            slo_workload=args.slo_workload or "adapters-smoke",
+            max_queue=args.max_queue or 64)
+        key = "adapters"
+    elif args.mode == "replay" and args.paging:
         result = run_paging_replay(
             seed=args.seed, requests=args.requests, rate_rps=rates[0],
             resume_fraction=args.resume_fraction,
@@ -1113,7 +1346,7 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             json.dump(evidence, f, indent=1)
             f.write("\n")
-    if args.mode == "replay" and result["slo_violations"]:
+    if args.mode in ("replay", "adapters") and result["slo_violations"]:
         for v in result["slo_violations"]:
             print(f"SLO VIOLATION: [{v['workload']}] {v['check']}: "
                   f"actual {v['actual']} violates SLO {v['limit']}")

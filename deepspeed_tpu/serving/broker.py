@@ -35,6 +35,7 @@ from typing import Deque, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..inference.v2.engine import AdmissionError, InferenceEngineV2
 from ..observability import replay as workload
+from .adapters import AdapterCapacityError, AdapterError, AdapterRegistry
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
 from ..utils import faults
@@ -97,6 +98,10 @@ class _Request:
     slo_class: str = "standard"
     #: admission priority from the SLO class table; lower admits first
     priority: int = 0
+    #: registry adapter id this request decodes through (None = base model)
+    adapter: Optional[str] = None
+    #: a registry slot ref is held between admission and finalize
+    adapter_ref: bool = False
     state: RequestState = RequestState.QUEUED
     uid: Optional[int] = None
     delivered: int = 0
@@ -166,11 +171,14 @@ class RequestBroker:
 
     def __init__(self, engine: InferenceEngineV2, config: ServingConfig,
                  metrics: Optional[ServingMetrics] = None,
-                 name: str = "replica0", own_gauges: bool = True):
+                 name: str = "replica0", own_gauges: bool = True,
+                 adapters: Optional[AdapterRegistry] = None):
         self.engine = engine
         self.cfg = config
         self.metrics = metrics or ServingMetrics()
         self.name = name
+        #: multi-tenant LoRA registry; None = base-model-only deployment
+        self.adapters = adapters
         self._own_gauges = own_gauges  # pool-managed brokers leave gauges to the pump
         self._lock = named_lock("broker.state")
         self._wake = threading.Condition(self._lock)
@@ -200,10 +208,20 @@ class RequestBroker:
                trace_id: Optional[str] = None,
                seed: Optional[int] = None,
                tenant: Optional[str] = None,
-               slo_class: Optional[str] = None) -> RequestHandle:
+               slo_class: Optional[str] = None,
+               adapter: Optional[str] = None) -> RequestHandle:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise InvalidRequestError("prompt must be a non-empty token list")
+        if adapter is not None:
+            if self.adapters is None:
+                raise InvalidRequestError(
+                    "this deployment serves no adapters (engine built "
+                    "without --adapter_slots)")
+            if not self.adapters.known(adapter):
+                raise InvalidRequestError(
+                    f"unknown adapter {adapter!r} (have "
+                    f"{self.adapters.ids()})")
         mnt = self.cfg.default_max_tokens if max_new_tokens is None \
             else int(max_new_tokens)
         if mnt <= 0:
@@ -238,7 +256,8 @@ class RequestBroker:
                 int(t) for t in stop_token_ids),
             deadline=None if deadline_s is None else now + deadline_s,
             submit_ts=now, temperature=temperature,
-            tenant=tenant or "default", slo_class=cls, priority=priority)
+            tenant=tenant or "default", slo_class=cls, priority=priority,
+            adapter=adapter)
         # rid-derived seed: deterministic across failover resubmits (the
         # balancer keeps the rid), unique-enough across requests
         req.seed = int(seed) if seed is not None \
@@ -264,7 +283,12 @@ class RequestBroker:
                              stop_token_ids=[int(t) for t in stop_token_ids],
                              deadline_s=deadline_s,
                              temperature=temperature,
-                             tenant=req.tenant, slo_class=cls)
+                             tenant=req.tenant, slo_class=cls,
+                             adapter=adapter)
+        if adapter is not None:
+            # promote-ahead: overlap the spill→host half of the adapter's
+            # promotion with its time in the admission queue
+            self.adapters.prefetch([adapter])
         request_logger(req.rid).info(
             f"serving: submitted to {self.name} "
             f"(prompt={len(prompt)} tok, budget={mnt})")
@@ -411,6 +435,9 @@ class RequestBroker:
 
     def _finalize_locked(self, req: _Request, reason: str,
                          detail: str = "") -> None:
+        if req.adapter_ref:
+            self.adapters.release(req.adapter)
+            req.adapter_ref = False
         req.finish_reason = reason
         req.finish_ts = time.monotonic()
         if reason in ("length", "stop"):
@@ -530,11 +557,31 @@ class RequestBroker:
             if req is None:
                 break
             try:
-                uid = self.engine.put(req.prompt, req.max_new_tokens,
-                                      strict=True,
-                                      temperature=req.temperature,
-                                      seed=req.seed)
-            except AdmissionError:
+                slot = 0
+                if req.adapter is not None:
+                    try:
+                        slot = self.adapters.acquire(req.adapter)
+                    except AdapterError:
+                        # retired between submit and admission: a request
+                        # disposition, not a capacity event
+                        self._queue.remove(req)
+                        self._finalize_locked(
+                            req, "adapter_retired",
+                            f"adapter {req.adapter!r} was retired while "
+                            "this request was queued")
+                        continue
+                    req.adapter_ref = True
+                try:
+                    uid = self.engine.put(req.prompt, req.max_new_tokens,
+                                          strict=True,
+                                          temperature=req.temperature,
+                                          seed=req.seed, adapter_slot=slot)
+                except AdmissionError:
+                    if req.adapter_ref:
+                        self.adapters.release(req.adapter)
+                        req.adapter_ref = False
+                    raise
+            except (AdmissionError, AdapterCapacityError):
                 break  # defer: capacity frees as running requests finish
             self._queue.remove(req)
             self._tenant_last_admit[req.tenant] = now
@@ -546,6 +593,13 @@ class RequestBroker:
             request_logger(req.rid, uid).info(
                 f"serving: admitted to {self.name} after "
                 f"{(now - req.submit_ts) * 1e3:.1f}ms in queue")
+        if self._queue and self.adapters is not None:
+            # admission lookahead: the requests that will land in the next
+            # few batches stage their spilled adapter bytes host-side now
+            look = [r.adapter for r in itertools.islice(
+                iter(self._queue), self.engine.cfg.max_seqs) if r.adapter]
+            if look:
+                self.adapters.prefetch(look)
 
     def _fail_all_locked(self, reason: str) -> None:
         for req in list(self._by_rid.values()):
@@ -618,6 +672,9 @@ class RequestBroker:
                                 self.engine.prefix_stats())
                             self.metrics.set_spec_stats(
                                 self.engine.spec_stats())
+                            if self.adapters is not None:
+                                self.metrics.set_adapter_stats(
+                                    self.adapters.stats())
                         self._wake.wait(self.cfg.idle_wait_s)
                         continue
                 # JAX outside the lock: submit/cancel stay non-blocking
@@ -630,6 +687,8 @@ class RequestBroker:
                         self.kv_utilization())
                     self.metrics.set_prefix_stats(self.engine.prefix_stats())
                     self.metrics.set_spec_stats(self.engine.spec_stats())
+                    if self.adapters is not None:
+                        self.metrics.set_adapter_stats(self.adapters.stats())
         except Exception as e:  # engine fault → fail outstanding, die
             logger.error(f"serving broker {self.name} engine fault: {e!r}")
             recorder.record_event("broker/engine_fault", replica=self.name,
@@ -644,3 +703,5 @@ class RequestBroker:
             close = getattr(self.engine, "close", None)
             if close is not None:
                 close()
+            if self.adapters is not None:
+                self.adapters.close()

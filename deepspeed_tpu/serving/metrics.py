@@ -158,6 +158,15 @@ class ServingMetrics:
             "tier_spill_blocks": 0, "demotions": 0, "promotions": 0,
             "promote_wait_ms": 0.0,
         }
+        # multi-adapter serving mirror (registry-owned gauges + paging
+        # counters from serving/adapters.py, summed over replicas by the
+        # pump; all zero without --adapter_slots)
+        self.adapters: Dict[str, float] = {
+            "resident": 0, "host": 0, "registered": 0, "refs": 0,
+            "loads": 0, "evictions": 0, "hits": 0,
+            "capacity_deferrals": 0, "promote_wait_ms": 0.0,
+            "host_bytes_used": 0, "spill_blocks": 0,
+        }
         # speculative-decoding mirror (engine-owned counters, summed over
         # replicas by the pump; all zero when spec_mode is "off")
         self.spec: Dict[str, float] = {
@@ -302,6 +311,15 @@ class ServingMetrics:
                 if k in stats:
                     self.kv[k] = stats[k]
 
+    def set_adapter_stats(self, stats: Dict[str, float]) -> None:
+        """Mirror adapter-registry stats (see
+        ``serving.adapters.AdapterRegistry.stats``); pools pass the sum
+        over replicas, brokers pass their own registry's view."""
+        with self._lock:
+            for k in self.adapters:
+                if k in stats:
+                    self.adapters[k] = stats[k]
+
     def set_spec_stats(self, stats: Dict[str, float]) -> None:
         """Mirror engine speculative-decoding stats (see
         ``InferenceEngineV2.spec_stats``); pools pass the sum over replicas,
@@ -341,6 +359,8 @@ class ServingMetrics:
                 out[f"prefix_{k}"] = float(v)
             for k, v in self.kv.items():
                 out[f"kv_{k}"] = float(v)
+            for k, v in self.adapters.items():
+                out[f"adapter_{k}"] = float(v)
             for k, v in self.spec.items():
                 out[f"spec_{k}"] = float(v)
             for k, v in self.fleet.items():
@@ -409,6 +429,10 @@ class ServingMetrics:
             b.gauge(f"{pre}kv_{k}",
                     f"KV memory hierarchy: {k.replace('_', ' ')}.",
                     snap[f"kv_{k}"])
+        for k in self.adapters:
+            b.gauge(f"{pre}adapter_{k}",
+                    f"Multi-adapter serving: {k.replace('_', ' ')}.",
+                    snap[f"adapter_{k}"])
         for k in self.spec:
             b.gauge(f"{pre}spec_{k}",
                     f"Speculative decoding: {k.replace('_', ' ')}.",

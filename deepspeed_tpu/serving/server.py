@@ -173,6 +173,11 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path == "/v1/adapters":
+            # per-replica resident/registered adapter census
+            self._json(200, {"replicas": [
+                {"name": t.name, **t.adapter_summary()}
+                for t in self.server.pool.replicas]})
         elif path == "/debug/requests":
             self._json(200, recorder.snapshot())
         elif path == "/debug/trace":
@@ -223,6 +228,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/v1/completions":
                 self._completions()
+            elif self.path == "/v1/adapters":
+                self._adapters_admin()
             elif self.path == "/v1/cancel":
                 body = self._read_body()
                 ok = self.server.cancel_rid(str(body.get("id", "")))
@@ -238,6 +245,34 @@ class _Handler(BaseHTTPRequestHandler):
                         headers=[("Retry-After", "1")])
         except NoReplicaError as e:
             self._error(503, str(e), "service_unavailable")
+
+    def _adapters_admin(self) -> None:
+        """Fleet adapter ops: ``{"op": "register", "adapter", "ckpt_dir"
+        [, "scaling"]}`` hot-loads a committed adapter checkpoint into
+        every healthy replica; ``{"op": "retire", "adapter"}`` retires it
+        fleet-wide (in-flight requests drain first)."""
+        from .adapters import fleet_register, fleet_retire
+
+        body = self._read_body()
+        op = body.get("op")
+        adapter = body.get("adapter")
+        if not isinstance(adapter, str) or not adapter:
+            raise InvalidRequestError("adapter must be a string adapter id")
+        if op == "register":
+            ckpt_dir = body.get("ckpt_dir")
+            if not isinstance(ckpt_dir, str) or not ckpt_dir:
+                raise InvalidRequestError("register needs a ckpt_dir")
+            try:
+                result = fleet_register(self.server.pool, adapter, ckpt_dir,
+                                        scaling=body.get("scaling"))
+            except (ValueError, OSError) as e:
+                raise InvalidRequestError(str(e))
+            self._json(200, result)
+        elif op == "retire":
+            self._json(200, fleet_retire(self.server.pool, adapter))
+        else:
+            raise InvalidRequestError(
+                f"unknown adapter op {op!r} (want register/retire)")
 
     def _parse_prompt(self, body: dict) -> List[int]:
         prompt = body.get("prompt")
@@ -259,6 +294,9 @@ class _Handler(BaseHTTPRequestHandler):
         if seed is not None and (isinstance(seed, bool)
                                  or not isinstance(seed, int)):
             raise InvalidRequestError("seed must be an integer")
+        adapter = body.get("adapter")
+        if adapter is not None and not isinstance(adapter, str):
+            raise InvalidRequestError("adapter must be a string adapter id")
         kwargs = dict(
             max_new_tokens=body.get("max_tokens"),
             temperature=body.get("temperature"),
@@ -267,6 +305,7 @@ class _Handler(BaseHTTPRequestHandler):
             seed=seed,
             tenant=body.get("tenant"),
             slo_class=body.get("slo_class"),
+            adapter=adapter,
         )
         handle = self.server.pool.submit(prompt, **kwargs)
         self.server.register(handle)
@@ -373,7 +412,9 @@ def build_engine_factory(args) -> Callable[[], "object"]:
                   kv_promote_ahead=args.kv_promote_ahead,
                   spec_mode=args.spec_mode, spec_k=args.spec_k,
                   quantize_bits=args.quantize_bits,
-                  quantize_group=args.quantize_group)
+                  quantize_group=args.quantize_group,
+                  adapter_slots=args.adapter_slots,
+                  adapter_rank=args.adapter_rank)
     draft_params, draft_cfg, spec_heads = None, None, None
     if args.spec_mode == "draft":
         draft_cfg = tfm.get_config(args.spec_draft_model or args.model,
@@ -402,6 +443,38 @@ def build_engine_factory(args) -> Callable[[], "object"]:
                                      draft_params=draft_params,
                                      draft_config=draft_cfg,
                                      spec_heads=spec_heads)
+
+
+def build_adapter_factory(args) -> Optional[Callable]:
+    """Per-replica :class:`~deepspeed_tpu.serving.adapters.AdapterRegistry`
+    factory from parsed engine CLI args; None when the deployment serves
+    no adapters (``--adapter_slots 0``).  ``--adapter_preload`` entries
+    are hot-loaded into every replica's registry at build time."""
+    if not getattr(args, "adapter_slots", 0):
+        return None
+    preload: List[Tuple[str, str]] = []
+    for item in (getattr(args, "adapter_preload", None) or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        aid, _, path = item.partition("=")
+        if not aid or not path:
+            raise ValueError(
+                f"--adapter_preload entry {item!r} must be ID=CKPT_DIR")
+        preload.append((aid, path))
+    host_mb = getattr(args, "adapter_host_pool_mb", 256)
+    spill_dir = getattr(args, "adapter_spill_dir", "") or ""
+
+    def factory(engine, name: str):
+        from .adapters import AdapterRegistry
+
+        reg = AdapterRegistry(engine, host_bytes=host_mb << 20,
+                              spill_dir=spill_dir, name=name)
+        for aid, path in preload:
+            reg.register(aid, ckpt_dir=path)
+        return reg
+
+    return factory
 
 
 def engine_argv_from_args(args) -> List[str]:
@@ -433,6 +506,14 @@ def engine_argv_from_args(args) -> List[str]:
         argv += ["--spec_draft_model", args.spec_draft_model]
     if args.spec_draft_seed is not None:
         argv += ["--spec_draft_seed", str(args.spec_draft_seed)]
+    if args.adapter_slots:
+        argv += ["--adapter_slots", str(args.adapter_slots),
+                 "--adapter_rank", str(args.adapter_rank),
+                 "--adapter_host_pool_mb", str(args.adapter_host_pool_mb)]
+        if args.adapter_spill_dir:
+            argv += ["--adapter_spill_dir", args.adapter_spill_dir]
+        if args.adapter_preload:
+            argv += ["--adapter_preload", args.adapter_preload]
     return argv
 
 
@@ -506,7 +587,8 @@ def _build_pool_from_args(args) -> Tuple[ReplicaPool, ServingMetrics,
             launch_workers=not getattr(args, "external_workers", False))
     else:
         pool = ReplicaPool.build(build_engine_factory(args), cfg,
-                                 metrics=metrics, monitor=monitor)
+                                 metrics=metrics, monitor=monitor,
+                                 adapter_factory=build_adapter_factory(args))
     return pool, metrics, cfg
 
 
@@ -572,6 +654,26 @@ def add_engine_cli_args(p) -> None:
                    help="self_draft: distill the speculation heads for this "
                         "many steps on the base model's greedy rollouts "
                         "before serving starts (0 = lm-head-seeded init)")
+    p.add_argument("--adapter_slots", type=int, default=0,
+                   help="multi-tenant LoRA serving: device adapter slots "
+                        "per replica INCLUDING the null base slot 0, so N "
+                        "slots hold N-1 resident adapters (0 = no adapter "
+                        "serving)")
+    p.add_argument("--adapter_rank", type=int, default=0,
+                   help="stacked adapter rank r; registered adapters of "
+                        "smaller rank are zero-padded to it (required with "
+                        "--adapter_slots)")
+    p.add_argument("--adapter_host_pool_mb", type=int, default=256,
+                   help="host-DRAM pool for paged-out adapters, MiB: "
+                        "registered adapters beyond the device slots stay "
+                        "host-resident and promote on demand")
+    p.add_argument("--adapter_spill_dir", default="",
+                   help="spill tier for the adapter host pool: overflow "
+                        "adapters land in safetensors files here")
+    p.add_argument("--adapter_preload", default=None,
+                   help="comma-separated ID=CKPT_DIR adapter checkpoints "
+                        "registered into every replica at startup (later "
+                        "adapters hot-register via the fleet ops)")
 
 
 def add_serving_cli_args(p) -> None:

@@ -34,11 +34,15 @@ Wire protocol (4-byte big-endian length + UTF-8 JSON, both directions):
   ``{"op": "cancel", "rid"}``, ``{"op": "fault", "spec"}`` (chaos hook:
   arm ``utils/faults`` sites inside the worker), ``{"op": "swap",
   "ckpt_dir", "cid"}`` / ``{"op": "swap_rollback", "cid"}`` (rolling
-  weight swaps — ``serving/rollout.py``), ``{"op": "stop"}``.
+  weight swaps — ``serving/rollout.py``), ``{"op": "adapter_register",
+  "adapter", "ckpt_dir", "cid"}`` / ``{"op": "adapter_retire",
+  "adapter", "cid"}`` (hot multi-adapter loads — ``serving/adapters.py``),
+  ``{"op": "stop"}``.
 * worker → pool: ``{"ev": "hb", "stats"}`` heartbeats (liveness + the
   stats the pool's routing and gauges need), ``accepted``/``rejected``
   submit acks, ``tok``/``done``/``err`` per-request stream frames,
-  ``swap_ok``/``swap_err`` control acks keyed by ``cid``.
+  ``swap_ok``/``swap_err`` / ``adapter_ok``/``adapter_err`` control acks
+  keyed by ``cid``.
 
 Frame hardening: a corrupt or hostile peer must cost one connection,
 never a traceback in the reader thread.  An oversized length prefix or
@@ -189,6 +193,16 @@ class ReplicaTransport(abc.ABC):
         ``PrefixCache.summary``); empty when the replica has none."""
         return {}
 
+    def adapter_stats(self) -> Dict[str, float]:
+        """Adapter-registry stats (``serving/adapters.py``); empty when
+        the replica serves no adapters."""
+        return {}
+
+    def adapter_summary(self) -> Dict[str, Any]:
+        """Resident/registered adapter ids for adapter-aware routing;
+        empty when the replica serves no adapters."""
+        return {}
+
     def describe(self) -> Dict[str, Any]:
         """Transport-specific health extras (process ids, generations)."""
         return {}
@@ -263,6 +277,36 @@ class InProcessReplica(ReplicaTransport):
 
     def prefix_summary(self) -> Dict[str, Any]:
         return self.broker.engine.prefix_summary()
+
+    def adapter_stats(self) -> Dict[str, float]:
+        reg = self.broker.adapters
+        return reg.stats() if reg is not None else {}
+
+    def adapter_summary(self) -> Dict[str, Any]:
+        reg = self.broker.adapters
+        return reg.summary() if reg is not None else {}
+
+    def adapter_register(self, adapter_id: str, ckpt_dir: str,
+                         scaling: Optional[float] = None,
+                         timeout: Optional[float] = None) -> None:
+        """Hot-load an adapter checkpoint into this replica's registry
+        (``serving/adapters.py`` fleet ops; no drain needed — registering
+        only adds routable state)."""
+        reg = self.broker.adapters
+        if reg is None:
+            raise RequestFailedError(
+                "adapter_failed",
+                f"replica {self.name} serves no adapters")
+        reg.register(adapter_id, ckpt_dir=ckpt_dir, scaling=scaling)
+
+    def adapter_retire(self, adapter_id: str,
+                       timeout: Optional[float] = None) -> bool:
+        reg = self.broker.adapters
+        if reg is None:
+            raise RequestFailedError(
+                "adapter_failed",
+                f"replica {self.name} serves no adapters")
+        return reg.retire(adapter_id)
 
 
 class RemoteHandle:
@@ -456,7 +500,7 @@ class FramedReplica(ReplicaTransport):
                 if events:
                     recorder.ingest_events(events, pid)
             return
-        if ev in ("swap_ok", "swap_err"):
+        if ev in ("swap_ok", "swap_err", "adapter_ok", "adapter_err"):
             with self._lock:
                 ctrl_q = self._ctrl.get(frame.get("cid"))
             if ctrl_q is not None:
@@ -606,7 +650,8 @@ class FramedReplica(ReplicaTransport):
             sock = self._sock
         msg = {"op": "submit", "rid": rid, "prompt": list(prompt)}
         for key in ("max_new_tokens", "temperature", "deadline_s",
-                    "stop_token_ids", "seed", "tenant", "slo_class"):
+                    "stop_token_ids", "seed", "tenant", "slo_class",
+                    "adapter"):
             if kwargs.get(key) is not None:
                 msg[key] = kwargs[key] if key != "stop_token_ids" \
                     else list(kwargs[key])
@@ -706,6 +751,29 @@ class FramedReplica(ReplicaTransport):
         if reply.get("ev") != "swap_ok":
             raise RequestFailedError("swap_failed", reply.get("detail", ""))
 
+    def adapter_register(self, adapter_id: str, ckpt_dir: str,
+                         scaling: Optional[float] = None,
+                         timeout: Optional[float] = None) -> None:
+        """Hot-load an adapter checkpoint into the worker's registry (no
+        quiesce — registering only adds routable state)."""
+        msg: Dict[str, Any] = {"op": "adapter_register",
+                               "adapter": adapter_id, "ckpt_dir": ckpt_dir}
+        if scaling is not None:
+            msg["scaling"] = float(scaling)
+        reply = self._control(msg, timeout)
+        if reply.get("ev") != "adapter_ok":
+            raise RequestFailedError("adapter_failed",
+                                     reply.get("detail", ""))
+
+    def adapter_retire(self, adapter_id: str,
+                       timeout: Optional[float] = None) -> bool:
+        reply = self._control({"op": "adapter_retire",
+                               "adapter": adapter_id}, timeout)
+        if reply.get("ev") != "adapter_ok":
+            raise RequestFailedError("adapter_failed",
+                                     reply.get("detail", ""))
+        return bool(reply.get("drained", True))
+
     # -- stats (heartbeat-carried; never raises on a dead worker) --------
 
     def _stat(self, key: str, default=0):
@@ -737,6 +805,12 @@ class FramedReplica(ReplicaTransport):
 
     def prefix_summary(self) -> Dict[str, Any]:
         return dict(self._stat("prefix_summary", {}))
+
+    def adapter_stats(self) -> Dict[str, float]:
+        return dict(self._stat("adapters", {}))
+
+    def adapter_summary(self) -> Dict[str, Any]:
+        return dict(self._stat("adapter_summary", {}))
 
     # -- supervisor surface ----------------------------------------------
 

@@ -25,8 +25,8 @@ worker meets its pool:
 Per-connection thread roles (both modes):
 
 * **reader**: op loop over ``submit`` / ``cancel`` / ``fault`` /
-  ``swap`` / ``swap_rollback`` / ``stop`` (frame format:
-  ``serving/transport.py``);
+  ``swap`` / ``swap_rollback`` / ``adapter_register`` /
+  ``adapter_retire`` / ``stop`` (frame format: ``serving/transport.py``);
 * **heartbeat**: every ``--heartbeat_interval_s``, one ``hb`` frame with
   the stats the pool's routing, gauges, and hung-replica detection need
   (plus piggybacked trace spans / flight events — cursors persist
@@ -85,7 +85,7 @@ EXIT_FENCED = 3
 
 def _stats(broker: RequestBroker) -> dict:
     eng = broker.engine
-    return {
+    stats = {
         "healthy": broker.healthy(),
         "busy": broker.busy(),
         "progress_age": broker.progress_age(),
@@ -101,6 +101,11 @@ def _stats(broker: RequestBroker) -> dict:
         # capped so a hot cache can't bloat the heartbeat frame
         "prefix_summary": eng.prefix_summary(max_digests=256),
     }
+    if broker.adapters is not None:
+        # registry digest for the pool's adapter-aware routing + gauges
+        stats["adapters"] = broker.adapters.stats()
+        stats["adapter_summary"] = broker.adapters.summary()
+    return stats
 
 
 def _pump(conn: socket.socket, wlock: threading.Lock, rid: str,
@@ -198,6 +203,37 @@ def _handle_swap(conn: socket.socket, wlock: threading.Lock,
             pass
 
 
+def _handle_adapter(conn: socket.socket, wlock: threading.Lock,
+                    broker: RequestBroker, frame: dict, name: str) -> None:
+    """Run an adapter_register / adapter_retire control op inline on the
+    reader thread (no quiesce: registering only adds routable state, and
+    retire drains in-flight refs on its own)."""
+    cid = frame.get("cid")
+    op = frame.get("op")
+    reply: dict = {"ev": "adapter_ok", "cid": cid}
+    try:
+        if broker.adapters is None:
+            raise RuntimeError(
+                f"worker {name} serves no adapters (--adapter_slots 0)")
+        adapter = frame["adapter"]
+        if op == "adapter_register":
+            logger.info(f"worker {name}: registering adapter {adapter!r} "
+                        f"from {frame.get('ckpt_dir')}")
+            broker.adapters.register(adapter, ckpt_dir=frame["ckpt_dir"],
+                                     scaling=frame.get("scaling"))
+        else:
+            logger.info(f"worker {name}: retiring adapter {adapter!r}")
+            reply["drained"] = broker.adapters.retire(adapter)
+    except Exception as e:  # noqa: BLE001 — a failed load must reach the
+        # fleet controller as a typed ack, not kill the worker
+        logger.error(f"worker {name}: {op} failed: {e!r}")
+        reply = {"ev": "adapter_err", "cid": cid, "detail": repr(e)}
+    try:
+        send_frame(conn, reply, wlock)
+    except OSError:
+        pass
+
+
 def _serve_conn(conn: socket.socket, broker: RequestBroker, name: str,
                 heartbeat_interval_s: float, stop_evt: threading.Event,
                 hb_state: _HeartbeatState, rfile=None) -> dict:
@@ -242,7 +278,8 @@ def _serve_conn(conn: socket.socket, broker: RequestBroker, name: str,
                         trace_id=trace_ctx.get("trace_id"),
                         seed=frame.get("seed"),
                         tenant=frame.get("tenant"),
-                        slo_class=frame.get("slo_class"))
+                        slo_class=frame.get("slo_class"),
+                        adapter=frame.get("adapter"))
                 except QueueFullError as e:
                     send_frame(conn, {"ev": "rejected", "rid": rid,
                                       "etype": "queue_full",
@@ -270,6 +307,8 @@ def _serve_conn(conn: socket.socket, broker: RequestBroker, name: str,
                 faults.configure(spec)
             elif op in ("swap", "swap_rollback"):
                 _handle_swap(conn, wlock, broker, frame, name)
+            elif op in ("adapter_register", "adapter_retire"):
+                _handle_adapter(conn, wlock, broker, frame, name)
             elif op == "stop":
                 result = {"exit": True,
                           "drain": bool(frame.get("drain", True)),
@@ -482,8 +521,13 @@ def main(argv: Optional[list] = None) -> int:
         slo_classes=parse_slo_classes(args.slo_classes),
         default_slo_class=args.default_slo_class)
     logger.info(f"worker {args.name}: building engine (model={args.model})")
-    broker = RequestBroker(build_engine_factory(args)(), scfg,
-                           name=args.name)
+    from .server import build_adapter_factory
+
+    engine = build_engine_factory(args)()
+    adapter_factory = build_adapter_factory(args)
+    adapters = (adapter_factory(engine, args.name)
+                if adapter_factory is not None else None)
+    broker = RequestBroker(engine, scfg, name=args.name, adapters=adapters)
     broker.start()
 
     if args.connect:

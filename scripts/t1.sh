@@ -180,6 +180,18 @@ then
     exit 2
 fi
 
+# adapter suite: imports the multi-tenant LoRA registry (serving/
+# adapters.py), the heterogeneous-adapter decode path, and the merged-
+# weight export seam
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_adapters.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_adapters.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi
@@ -206,9 +218,12 @@ T1_GROUPS=${T1_GROUPS:-6}
 # test_paging joins them: the pager's promote-ahead thread and spill
 # writer interleave with the broker/engine locks, so the whole tiered-KV
 # suite runs lock-order-checked too.
+# test_adapters likewise: the adapter registry lock nests against the
+# broker/engine/pager locks on the admission and retire paths, so the
+# multi-tenant suite is lock-order-checked on every CI run.
 mapfile -t T1_FILES < <(ls tests/test_*.py \
     | grep -v -e 'test_remote_fleet' -e 'test_disagg' -e 'test_fleet\.py' \
-        -e 'test_paging' \
+        -e 'test_paging' -e 'test_adapters' \
     | sort)
 rc=0
 rm -f /tmp/_t1.log
@@ -252,6 +267,15 @@ fi
 echo "== t1: group paging (lockdep): tests/test_paging.py =="
 timeout -k 10 1800 env JAX_PLATFORMS=cpu DSTPU_LOCKDEP=1 \
     python -m pytest tests/test_paging.py -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
+grc=${PIPESTATUS[0]}
+if [ "$grc" -ne 0 ] && [ "$grc" -ne 5 ]; then
+    rc=$grc
+fi
+echo "== t1: group adapters (lockdep): tests/test_adapters.py =="
+timeout -k 10 1800 env JAX_PLATFORMS=cpu DSTPU_LOCKDEP=1 \
+    python -m pytest tests/test_adapters.py -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
 grc=${PIPESTATUS[0]}

@@ -1,0 +1,401 @@
+"""Multi-tenant adapter serving: per-request LoRA routing over one shared
+base (S-LoRA / Punica style).
+
+The contract under test is the ISSUE-19 tentpole: a mixed batch where
+every row decodes through a *different* adapter (or none) must be
+token-identical — greedy rows bit-identical, sampled rows seed-identical
+— to a dedicated engine whose weights were merged offline for that one
+adapter.  Around that oracle: registry residency (refcounts, LRU slot
+eviction, host-tier paging, zero leaks after drain), hot register/retire
+while requests are in flight, request validation (unknown adapter,
+adapter on a base-only deployment), composition with self-draft
+speculation, and the ``export_merged_weights(adapter_id=...)`` seam.
+
+The whole file also runs under ``DSTPU_LOCKDEP=1`` in its own tier-1
+partition (scripts/t1.sh): the registry lock is order-checked against
+the broker, engine, and pager locks on every CI run.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine import (AdmissionError,
+                                               InferenceEngineV2, V2Config,
+                                               adapter_target_shapes)
+from deepspeed_tpu.linear.optimized_linear import (graft_adapter_pack,
+                                                   merge_lora_weights)
+from deepspeed_tpu.models import transformer as tfm
+from deepspeed_tpu.runtime.checkpoint.engine import (export_merged_weights,
+                                                     load_merged_params)
+from deepspeed_tpu.serving import RequestBroker, ServingConfig
+from deepspeed_tpu.serving.adapters import (AdapterCapacityError, AdapterError,
+                                            AdapterRegistry,
+                                            load_adapter_pack,
+                                            publish_adapter)
+from deepspeed_tpu.serving.broker import (InvalidRequestError,
+                                          RequestFailedError)
+
+V2 = dict(max_tokens_per_step=32, max_seqs=4, block_size=8, num_blocks=64,
+          max_blocks_per_seq=8, dtype="float32", adapter_slots=4,
+          adapter_rank=4)
+RANK = 4
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tfm.get_config("tiny", dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _make_pack(model_cfg, i, rank=RANK):
+    """Deterministic per-adapter factors, large enough that adapter rows
+    demonstrably diverge from the base (the 0.5-scale ``b`` flips argmax
+    on the tiny model — a too-small delta would make every identity test
+    vacuously pass)."""
+    rng = np.random.default_rng(1000 + i)
+    L = model_cfg.num_layers
+    pack = {}
+    for target, (K, N) in adapter_target_shapes(model_cfg).items():
+        a = (rng.standard_normal((L, K, rank)) / np.sqrt(K)).astype(np.float32)
+        b = (0.5 * rng.standard_normal((L, rank, N))).astype(np.float32)
+        pack[target] = (a, b)
+    return pack
+
+
+def _engine(tiny_model, **over):
+    cfg, params = tiny_model
+    return InferenceEngineV2(cfg, params, V2Config(**{**V2, **over}))
+
+
+@pytest.fixture(scope="module")
+def dedicated(tiny_model):
+    """Oracle: one dedicated single-adapter engine per adapter index, its
+    weights merged offline (``W + A @ B``) — what a tenant would get from
+    a private deployment.  ``i=None`` is the plain base engine."""
+    cfg, params = tiny_model
+    plain = {k: v for k, v in V2.items() if not k.startswith("adapter")}
+    engines = {}
+
+    def tokens(i, prompt, n=6, temperature=None, seed=0):
+        if i not in engines:
+            p = params if i is None else merge_lora_weights(
+                graft_adapter_pack(params, _make_pack(cfg, i), scaling=1.0))
+            engines[i] = InferenceEngineV2(cfg, p, V2Config(**plain))
+        eng = engines[i]
+        uid = eng.put(list(prompt), max_new_tokens=n,
+                      temperature=temperature, seed=seed)
+        return [int(t) for t in eng.generate_all()[uid][len(prompt):]]
+
+    return tokens
+
+
+def _registry(eng, ids, **kw):
+    cfg = eng.model_cfg
+    reg = AdapterRegistry(eng, **kw)
+    for i, aid in enumerate(ids):
+        reg.register(aid, pack=_make_pack(cfg, i))
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# registry residency (no broker)
+# ---------------------------------------------------------------------------
+
+
+def test_registry_acquire_release_lru_evict(tiny_model):
+    eng = _engine(tiny_model)  # 4 slots -> 3 usable (slot 0 = null)
+    reg = _registry(eng, ["a0", "a1", "a2", "a3"])
+    s0 = reg.acquire("a0")
+    assert 0 < s0 < V2["adapter_slots"]
+    assert reg.acquire("a0") == s0  # resident: refcount bump, same slot
+    assert reg.stats()["hits"] == 1
+    reg.release("a0")
+    reg.release("a0")
+    s1, s2 = reg.acquire("a1"), reg.acquire("a2")
+    assert len({s0, s1, s2}) == 3  # all three usable slots now occupied
+    reg.release("a1"), reg.release("a2")
+    # no free slot left: a3 must LRU-evict a0 (the coldest idle resident)
+    s3 = reg.acquire("a3")
+    assert s3 == s0 and reg.stats()["evictions"] == 1
+    reg.release("a3")
+    # a0 was demoted, not lost: re-acquire promotes it back from the host
+    reg.acquire("a0")
+    reg.release("a0")
+    st = reg.stats()
+    assert st["loads"] == 5 and st["registered"] == 4 and st["refs"] == 0
+    assert st["resident"] == 3  # released adapters stay warm in their slot
+    for aid in ("a0", "a1", "a2", "a3"):
+        reg.retire(aid)
+    reg.check_leaks()
+    reg.close()
+
+
+def test_registry_capacity_and_validation(tiny_model):
+    eng = _engine(tiny_model, adapter_slots=2)  # one usable slot
+    reg = _registry(eng, ["a0", "a1"])
+    assert reg.acquire("a0") == 1
+    # the only slot is pinned by a running request: admission must defer,
+    # not evict pinned state out from under a live row
+    with pytest.raises(AdapterCapacityError):
+        reg.acquire("a1")
+    reg.release("a0")
+    assert reg.acquire("a1") == 1  # freed ref -> a0 evictable -> a1 lands
+    reg.release("a1")
+    with pytest.raises(AdapterError, match="already registered"):
+        reg.register("a0", pack=_make_pack(eng.model_cfg, 0))
+    with pytest.raises(AdapterError, match="exactly one"):
+        reg.register("x", ckpt_dir="/tmp/nope", pack=_make_pack(
+            eng.model_cfg, 0))
+    with pytest.raises(AdapterError, match="unknown adapter"):
+        reg.acquire("ghost")
+    with pytest.raises(AdapterError, match="unknown adapter"):
+        reg.retire("ghost")
+    bad = _make_pack(eng.model_cfg, 0)
+    bad["wq"] = (bad["wq"][0][:, :-1, :], bad["wq"][1])
+    with pytest.raises(AdapterError, match="wq"):
+        reg.register("bad", pack=bad)
+    reg.retire("a0"), reg.retire("a1")
+    reg.check_leaks()
+    reg.close()
+
+
+def test_registry_retire_with_inflight_refs(tiny_model):
+    """Retire while a request holds the slot: routing stops immediately,
+    the slot + host bytes are reclaimed only when the last ref drops."""
+    eng = _engine(tiny_model)
+    reg = _registry(eng, ["a0"])
+    reg.acquire("a0")
+    assert reg.retire("a0") is False  # not purged: one in-flight ref
+    assert not reg.known("a0") and reg.ids() == []
+    reg.release("a0")  # last ref -> purge (slot freed, pager handle dropped)
+    assert reg.stats()["registered"] == 0
+    reg.check_leaks()
+    reg.close()
+
+
+# ---------------------------------------------------------------------------
+# the tentpole oracle: mixed heterogeneous-adapter batches
+# ---------------------------------------------------------------------------
+
+
+def _run_mixed_pool(tiny_model, cases):
+    """Pre-queue ``cases`` on a paused broker, then run to completion —
+    the fully deterministic schedule two identical pools can replay
+    bit-for-bit (the engine rng is PRNGKey(0) at construction)."""
+    eng = _engine(tiny_model)
+    reg = _registry(eng, ["a0", "a1", "a2"])
+    broker = RequestBroker(eng, ServingConfig(), adapters=reg)
+    handles = [broker.submit(list(p), max_new_tokens=6, adapter=aid,
+                             temperature=t, seed=s) for aid, p, t, s in cases]
+    broker.start()
+    try:
+        outs = [h.result(timeout=300) for h in handles]
+        reg.check_leaks()  # every finished request dropped its ref
+        assert reg.stats()["resident"] <= V2["adapter_slots"] - 1
+    finally:
+        broker.stop()
+    return outs
+
+
+def test_mixed_batch_token_identity(tiny_model, dedicated):
+    """One shared-base pool serving base + three adapters in the SAME
+    batches, greedy and sampled rows interleaved.  Greedy rows must be
+    bit-identical to their dedicated merged-weight engine (same f32
+    logits through the same argmax — sharing the batch with other
+    tenants' sampled rows must not perturb them).  Sampled rows fold the
+    step rng + row index into their key, so their oracle is seeded
+    reproducibility: an identical pool replaying the identical workload
+    reproduces every sampled stream bit-for-bit."""
+    lanes = [None, "a0", "a1", "a2"]
+    cases = []  # (adapter_id, prompt, temperature, seed)
+    for i in range(8):
+        aid = lanes[i % 4]
+        temp = 0.7 if i >= 4 else None  # back half samples
+        cases.append((aid, [7 * i + j for j in range(1, 6)], temp, 100 + i))
+    outs = _run_mixed_pool(tiny_model, cases)
+    for got, (aid, p, t, s) in zip(outs, cases):
+        if t is None:
+            idx = None if aid is None else int(aid[1:])
+            want = dedicated(idx, p, n=6)
+            assert got == want, f"adapter={aid}: {got} != {want}"
+        else:
+            assert len(got) == 6  # sampled row ran to budget in-batch
+    assert _run_mixed_pool(tiny_model, cases) == outs
+    # adapters demonstrably change the output (the identity above is not
+    # vacuous): adapter rows differ from the base continuation
+    base = dedicated(None, cases[1][1], n=6)
+    assert dedicated(0, cases[1][1], n=6) != base
+
+
+def test_adapter_paging_pressure_zero_leaks(tiny_model, dedicated):
+    """More tenants than device slots: the registry must page adapters
+    through the host tier mid-run (evictions > 0, residency bounded by
+    the slot count) while every stream stays exact, and drain with zero
+    leaked refs or slots."""
+    eng = _engine(tiny_model)  # 3 usable slots
+    reg = _registry(eng, [f"a{i}" for i in range(5)])
+    broker = RequestBroker(eng, ServingConfig(), adapters=reg).start()
+    try:
+        cases = [(i % 5, [11 * i + j for j in range(1, 5)])
+                 for i in range(10)]
+        handles = [broker.submit(list(p), max_new_tokens=4,
+                                 adapter=f"a{ai}") for ai, p in cases]
+        for h, (ai, p) in zip(handles, cases):
+            assert h.result(timeout=300) == dedicated(ai, p, n=4)
+        st = reg.stats()
+        assert st["evictions"] > 0, "5 adapters / 3 slots never paged"
+        assert st["resident"] <= 3 and st["refs"] == 0
+        assert st["hits"] + st["loads"] >= 10
+        reg.check_leaks()
+    finally:
+        broker.stop()
+
+
+def test_self_draft_composes_with_adapters(tiny_model, dedicated):
+    """Speculative self-draft is lossless for greedy decode, so a
+    spec-enabled mixed-adapter pool must still match the plain dedicated
+    engines exactly."""
+    eng = _engine(tiny_model, spec_mode="self_draft", spec_k=2)
+    reg = _registry(eng, ["a0", "a1"])
+    broker = RequestBroker(eng, ServingConfig(), adapters=reg).start()
+    try:
+        cases = [(None, [3, 5, 7, 9]), ("a0", [4, 6, 8, 10]),
+                 ("a1", [5, 10, 15, 20]), ("a0", [2, 4, 8, 16])]
+        handles = [broker.submit(list(p), max_new_tokens=6, adapter=aid)
+                   for aid, p in cases]
+        for h, (aid, p) in zip(handles, cases):
+            idx = None if aid is None else int(aid[1:])
+            assert h.result(timeout=300) == dedicated(idx, p, n=6)
+        reg.check_leaks()
+    finally:
+        broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# hot register / retire + request validation (broker path)
+# ---------------------------------------------------------------------------
+
+
+def test_hot_register_and_retire_midstream(tiny_model, dedicated):
+    """Adapters come and go without restarting the pool: a tenant
+    registered mid-run is immediately routable; retiring one fails its
+    *queued* requests with ``adapter_retired`` (a request disposition,
+    not a broker error) and rejects new submits, while the base keeps
+    serving."""
+    eng = _engine(tiny_model)
+    reg = _registry(eng, ["a0"])
+    broker = RequestBroker(eng, ServingConfig(), adapters=reg)
+    # queue while paused so admission order is deterministic
+    h_doomed = broker.submit([1, 2, 3, 4], max_new_tokens=4, adapter="a0")
+    reg.retire("a0")  # retired between submit and admission
+    with pytest.raises(InvalidRequestError, match="unknown adapter"):
+        broker.submit([1, 2, 3], max_new_tokens=4, adapter="a0")
+    # hot-register a NEW tenant on the live registry
+    reg.register("a1", pack=_make_pack(eng.model_cfg, 1))
+    h_live = broker.submit([4, 6, 8, 10], max_new_tokens=4, adapter="a1")
+    h_base = broker.submit([9, 8, 7, 6], max_new_tokens=4)
+    broker.start()
+    try:
+        with pytest.raises(RequestFailedError, match="retired"):
+            h_doomed.result(timeout=300)
+        assert h_live.result(timeout=300) == dedicated(1, [4, 6, 8, 10], n=4)
+        assert h_base.result(timeout=300) == dedicated(
+            None, [9, 8, 7, 6], n=4)
+        reg.retire("a1")
+        reg.check_leaks()
+    finally:
+        broker.stop()
+
+
+def test_request_validation(tiny_model):
+    eng = _engine(tiny_model)
+    reg = _registry(eng, ["a0"])
+    broker = RequestBroker(eng, ServingConfig(), adapters=reg)
+    with pytest.raises(InvalidRequestError, match="unknown adapter"):
+        broker.submit([1, 2, 3], adapter="nope")
+    broker.stop()
+    reg.retire("a0")
+    reg.close()
+    # base-only deployment: adapter requests are a client error, loudly
+    cfg, params = tiny_model
+    plain = {k: v for k, v in V2.items() if not k.startswith("adapter")}
+    base_eng = InferenceEngineV2(cfg, params, V2Config(**plain))
+    with pytest.raises(AdapterError, match="adapter_slots"):
+        AdapterRegistry(base_eng)
+    base_broker = RequestBroker(base_eng, ServingConfig())
+    with pytest.raises(InvalidRequestError, match="serves no adapters"):
+        base_broker.submit([1, 2, 3], adapter="a0")
+    base_broker.stop()
+    with pytest.raises(AdmissionError, match="without adapter_slots"):
+        base_eng.put([1, 2, 3], max_new_tokens=2, adapter_slot=1)
+    eng2 = _engine(tiny_model)
+    with pytest.raises(AdmissionError, match="out of range"):
+        eng2.put([1, 2, 3], max_new_tokens=2,
+                 adapter_slot=V2["adapter_slots"])
+
+
+# ---------------------------------------------------------------------------
+# checkpoint seams: publish/load roundtrip + merged export by registry id
+# ---------------------------------------------------------------------------
+
+
+def test_publish_load_roundtrip_and_rank_padding(tiny_model, tmp_path):
+    cfg, _ = tiny_model
+    rank = 2  # narrower than the deployment's adapter_rank=4
+    rng = np.random.default_rng(7)
+    L = cfg.num_layers
+    tree = {}
+    for target, (K, N) in adapter_target_shapes(cfg).items():
+        tree[target] = {
+            "lora_a": rng.standard_normal((L, K, rank)).astype(np.float32),
+            "lora_b": rng.standard_normal((L, rank, N)).astype(np.float32)}
+    d = publish_adapter(tree, str(tmp_path), "tenant-x", scaling=0.5)
+    pack = load_adapter_pack(d, cfg, adapter_rank=RANK)
+    for target in tree:
+        a, b = pack[target]
+        K, N = adapter_target_shapes(cfg)[target]
+        # zero-padded exactly to the deployment rank (bit-free delta)
+        assert a.shape == (L, K, RANK) and b.shape == (L, RANK, N)
+        assert np.array_equal(a[:, :, :rank], tree[target]["lora_a"])
+        # manifest scaling folded into b
+        assert np.allclose(b[:, :rank, :],
+                           0.5 * tree[target]["lora_b"], atol=1e-7)
+        assert not a[:, :, rank:].any() and not b[:, rank:, :].any()
+    with pytest.raises(AdapterError, match="rank"):
+        load_adapter_pack(d, cfg, adapter_rank=1)  # wider than deployment
+
+
+def test_export_merged_weights_by_registry_id(tiny_model, tmp_path):
+    """Satellite 1: a tenant leaves multi-tenant serving with the same
+    artifact a dedicated deployment would use — ``export_merged_weights``
+    pulls the factors out of the live registry by adapter id and folds
+    them into the shared base."""
+    cfg, params = tiny_model
+    eng = _engine(tiny_model)
+    reg = _registry(eng, ["a0", "a1"])
+    out = export_merged_weights(eng, str(tmp_path / "exp"), adapter_id="a1",
+                                adapters=reg)
+    merged = load_merged_params(out, template=jax.tree.map(np.asarray,
+                                                           params))
+    # identical to merging the same pack locally
+    want = merge_lora_weights(graft_adapter_pack(
+        jax.tree.map(np.asarray, params), _make_pack(cfg, 1), scaling=1.0))
+    got_l, want_l = (jax.tree_util.tree_leaves(t) for t in (merged, want))
+    assert len(got_l) == len(want_l)
+    for g, w in zip(got_l, want_l):
+        assert np.allclose(np.asarray(g), np.asarray(w), atol=1e-6)
+    with open(os.path.join(out, "engine_state.json")) as f:
+        assert json.load(f)["merged_adapter_id"] == "a1"
+    with pytest.raises(AdapterError, match="unknown adapter"):
+        export_merged_weights(eng, str(tmp_path / "exp2"),
+                              adapter_id="ghost", adapters=reg)
+    with pytest.raises(ValueError, match="AdapterRegistry"):
+        export_merged_weights(eng, str(tmp_path / "exp3"), adapter_id="a0")
+    reg.retire("a0"), reg.retire("a1")
+    reg.check_leaks()
+    reg.close()
