@@ -76,8 +76,16 @@ class V2Config:
     # so a returning session promotes instead of recomputing.  All paging
     # is host-side: the compiled prefill/decode HLO is identical on/off.
     kv_host_pool_mb: int = 0  # 0 disables the paging tier entirely
+    # exact-bytes override of kv_host_pool_mb (tests/benches sizing the
+    # host pool below one MiB to force bottom-tier overflow; 0 = use mb)
+    kv_host_pool_bytes: int = 0
     kv_spill_dir: str = ""  # third tier: safetensors spill files (optional)
     kv_promote_ahead: bool = False  # background disk→host prefetch thread
+    # crash-durable cold tier (inference/v2/coldstore.py): host-pool
+    # overflow lands as manifest-verified committed entries keyed by chain
+    # digest instead of bare spill files, and ``rehydrate_coldstore()``
+    # re-adopts surviving entries into the radix tree after a restart
+    kv_coldstore_dir: str = ""  # replaces kv_spill_dir's bottom tier
     # speculative decoding (inference/v2/spec.py): "draft" proposes with a
     # small second model, "self_draft" with Medusa-style bolt-on heads
     # (linear/spec_heads.py); spec_k tokens proposed per step, verified in
@@ -649,13 +657,18 @@ class InferenceEngineV2:
                 eviction=self.cfg.prefix_eviction)
             self.kv.prefix_cache = self.prefix_cache
             self._cow_copy = build_cow_copy()
-            if self.cfg.kv_host_pool_mb > 0:
+            if self.cfg.kv_host_pool_mb > 0 or self.cfg.kv_host_pool_bytes:
+                from .coldstore import ColdStore
                 from .paging import BlockPager
 
+                cold = (ColdStore(self.cfg.kv_coldstore_dir)
+                        if self.cfg.kv_coldstore_dir else None)
                 self.pager = BlockPager(
-                    host_bytes=self.cfg.kv_host_pool_mb << 20,
+                    host_bytes=(self.cfg.kv_host_pool_bytes
+                                or self.cfg.kv_host_pool_mb << 20),
                     spill_dir=self.cfg.kv_spill_dir,
-                    promote_ahead=self.cfg.kv_promote_ahead)
+                    promote_ahead=self.cfg.kv_promote_ahead,
+                    coldstore=cold)
                 self.prefix_cache.attach_pager(
                     self.pager, self._demote_node, self._promote_node)
         self.builder = RaggedBatchBuilder(self.cfg.max_tokens_per_step,
@@ -861,10 +874,19 @@ class InferenceEngineV2:
             "tier_device_blocks": 0, "tier_host_blocks": 0,
             "tier_spill_blocks": 0, "demotions": 0, "promotions": 0,
             "promote_wait_ms": 0.0,
+            # crash-durable cold tier (inference/v2/coldstore.py)
+            "tier_cold_blocks": 0, "rehydrated_blocks": 0,
+            "gc_spill_files": 0, "coldstore_entries": 0,
+            "coldstore_bytes": 0, "coldstore_writes": 0,
+            "coldstore_corrupt_dropped": 0, "coldstore_gc_tmp": 0,
         }
         if self.prefix_cache is not None:
             stats.update(self.prefix_cache.stats())
             stats["enabled"] = 1
+        if self.pager is not None:
+            stats["gc_spill_files"] = self.pager.gc_spill_files
+            if self.pager.coldstore is not None:
+                stats.update(self.pager.coldstore.stats())
         stats["pinned_blocks"] = self.pinned_blocks
         return stats
 
@@ -972,15 +994,97 @@ class InferenceEngineV2:
     def _demote_node(self, node) -> Optional[Tuple[int, str]]:
         """Prefix-cache demote callback: serialize the node's device block
         into the pager.  Returns ``(handle, tier)`` or ``None`` (pager
-        full → the caller falls back to true eviction)."""
+        full → the caller falls back to true eviction).
+
+        With a cold store attached, the block also gets its *durable
+        identity*: the chain digest of its full token prefix becomes the
+        cold-store key, and the manifest meta carries the chain tokens —
+        everything a respawned worker needs to rebuild the radix path in
+        ``rehydrate_coldstore``."""
         sp = tracer.begin("paging/demote", block=int(node.block))
-        res = self.pager.put(self._read_kv_block(node.block))
+        meta = key = None
+        if self.pager.coldstore is not None:
+            from .prefix_cache import chain_tokens, prefix_digests
+
+            tokens = chain_tokens(node)
+            bs = self.cfg.block_size
+            key = "kv-" + prefix_digests(tokens, bs)[-1]
+            meta = {"kind": "kv_block",
+                    "tokens": ",".join(str(t) for t in tokens),
+                    "block_size": str(bs)}
+        res = self.pager.put(self._read_kv_block(node.block),
+                             metadata=meta, durable_key=key)
         if res is None:
             tracer.end(sp, ok=False, full=True)
             return None
         handle, tier = res
         tracer.end(sp, ok=True, handle=handle, tier=tier)
         return handle, tier
+
+    def rehydrate_coldstore(self) -> Dict[str, int]:
+        """Restart rehydration: re-adopt the cold-store entries a crashed
+        (or gracefully restarted) predecessor left behind, so resumed
+        sessions promote instead of re-prefilling.
+
+        Every entry is verified BEFORE adoption (sha256 manifest + its
+        key recomputed from the chain tokens it claims) — a torn, corrupt
+        or tampered entry is deleted and the prefix degrades to
+        re-prefill, never to wrong tokens.  Entries whose ancestor chunks
+        did not survive are orphans and are deleted too (a radix chunk is
+        only reachable through its full chain).  Returns adoption counts;
+        a no-op without a cold store or prefix cache."""
+        out = {"adopted": 0, "orphaned": 0, "skipped": 0}
+        pager = self.pager
+        if (pager is None or pager.coldstore is None
+                or self.prefix_cache is None):
+            return out
+        from ...utils import faults
+        from .prefix_cache import prefix_digests
+
+        cs = pager.coldstore
+        bs = self.cfg.block_size
+        sp = tracer.begin("coldstore/rehydrate_kv")
+        chains: List[Tuple[str, List[int], int]] = []
+        for key, meta, nbytes in cs.entries():
+            if meta.get("kind") != "kv_block":
+                continue  # not ours (e.g. an adapter section sharing root)
+            try:
+                entry_bs = int(meta.get("block_size", -1))
+                tokens = [int(t) for t in
+                          str(meta.get("tokens", "")).split(",") if t]
+            except ValueError:
+                entry_bs, tokens = -1, []
+            if (entry_bs != bs or not tokens or len(tokens) % bs != 0
+                    or key != "kv-" + prefix_digests(tokens, bs)[-1]):
+                cs.delete(key)  # wrong geometry or tampered meta
+                out["skipped"] += 1
+                continue
+            chains.append((key, tokens, nbytes))
+        chains.sort(key=lambda c: len(c[1]))  # parent-first (shallow first)
+        for key, tokens, nbytes in chains:
+            faults.maybe_fail("serving.coldstore.rehydrate")
+            if cs.read(key) is None:  # verify-before-adopt; corrupt → GC'd
+                out["skipped"] += 1
+                continue
+            handle = pager.adopt(key, nbytes)
+            if handle is None:
+                out["skipped"] += 1
+                continue
+            status = self.prefix_cache.adopt_demoted(tokens, handle,
+                                                     tier="cold")
+            if status == "adopted":
+                out["adopted"] += 1
+            elif status == "duplicate":
+                # the chain is already in the tree, and its node may be
+                # backed by this very durable entry — unwind the handle
+                # bookkeeping WITHOUT deleting the shared entry
+                pager.forget(handle)
+                out["skipped"] += 1
+            else:  # orphan: unreachable without its ancestors
+                pager.drop(handle)  # unwind + delete the dead entry
+                out["orphaned"] += 1
+        tracer.end(sp, **out)
+        return out
 
     def _promote_node(self, node) -> bool:
         """Prefix-cache promote callback: fetch a demoted node's bytes

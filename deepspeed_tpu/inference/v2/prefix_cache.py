@@ -74,10 +74,24 @@ class _Node:
         default_factory=dict)
     last_used: int = 0
     #: which memory tier holds this chunk's KV bytes: "device" (block is a
-    #: live pool id), "host" or "spill" (block is -1, ``handle`` names the
-    #: pager entry).  Anything != "device" is paged out.
+    #: live pool id), "host", "spill" or "cold" (block is -1, ``handle``
+    #: names the pager entry).  Anything != "device" is paged out.
     tier: str = "device"
     handle: Optional[int] = None
+
+
+def chain_tokens(node: _Node) -> List[int]:
+    """The full token prefix from the root through ``node`` — the durable
+    identity of a radix chunk (its cold-store key is the chain digest of
+    exactly these tokens, see ``engine._demote_node``)."""
+    chunks: List[Tuple[int, ...]] = []
+    while node is not None and node.parent is not None:
+        chunks.append(node.chunk)
+        node = node.parent
+    out: List[int] = []
+    for chunk in reversed(chunks):
+        out.extend(int(t) for t in chunk)
+    return out
 
 
 @dataclasses.dataclass
@@ -287,6 +301,41 @@ class PrefixCache:
         if blocks[n_full:]:
             self.allocator.free(blocks[n_full:])
 
+    def adopt_demoted(self, tokens: Sequence[int], handle: int,
+                      tier: str = "cold") -> str:
+        """Restart rehydration: re-adopt one surviving paged-out chunk as
+        a demoted tree node (``block = -1``, pager ``handle``), WITHOUT
+        touching the device.  ``tokens`` is the chunk's full chain prefix
+        (a multiple of ``block_size``); every ancestor chunk must already
+        be in the tree, so callers adopt parent-first.  Returns a status:
+        ``"adopted"``, ``"orphan"`` (an ancestor chunk didn't survive —
+        the chunk is unreachable and its entry should be dropped), or
+        ``"duplicate"`` (the chain is already cached — the caller must
+        unwind its handle WITHOUT deleting the durable entry, which a
+        live node may share).  A later :meth:`match` promotes an adopted
+        node through the normal engine callback, so rehydrated bytes
+        re-enter the device path exactly like any demoted block."""
+        bs = self.block_size
+        if len(tokens) < bs or len(tokens) % bs != 0:
+            return "orphan"
+        node = self._root
+        n = len(tokens) // bs
+        for i in range(n - 1):
+            child = node.children.get(tuple(tokens[i * bs:(i + 1) * bs]))
+            if child is None:
+                return "orphan"
+            node = child
+        last = tuple(tokens[(n - 1) * bs:n * bs])
+        if last in node.children:
+            return "duplicate"
+        self._clock += 1
+        child = _Node(chunk=last, block=-1, parent=node, tier=tier,
+                      handle=handle, last_used=self._clock)
+        node.children[last] = child
+        self._nodes.append(child)
+        self.allocator.note_demote()
+        return "adopted"
+
     # -- eviction ------------------------------------------------------
 
     def evict(self, n: int) -> int:
@@ -489,6 +538,8 @@ class PrefixCache:
             "tier_device_blocks": self.device_blocks,
             "tier_host_blocks": pg.host_blocks if pg else 0,
             "tier_spill_blocks": pg.spill_blocks if pg else 0,
+            "tier_cold_blocks": pg.cold_blocks if pg else 0,
+            "rehydrated_blocks": pg.rehydrated if pg else 0,
             "demotions": pg.demotions if pg else 0,
             "promotions": pg.promotions if pg else 0,
             "promote_wait_ms": pg.promote_wait_total_ms if pg else 0.0,

@@ -766,6 +766,12 @@ _SLO_KEYS = {
     # latency, device residency ceiling, and the registry leak gate
     "max_token_mismatches", "max_adapter_promote_ms_p95",
     "max_resident_adapters", "max_leaked_adapters", "min_adapter_hit_rate",
+    # crash-durable warm-state scenario (bench --mode replay --restart):
+    # blocks the respawned generation adopted from its predecessor's cold
+    # store, resume-wave hit rate and its gain over the cold-respawn arm
+    # on the identical seeded workload, and the worker-process leak gate
+    "min_rehydrated_blocks", "min_restart_hit_rate", "min_restart_hit_gain",
+    "max_leaked_procs",
 }
 
 

@@ -36,6 +36,12 @@ Fault sites (``utils/faults.py``): ``ckpt.write.model``,
 ``ckpt.write.optimizer``, ``ckpt.write.meta``, ``ckpt.write.manifest``,
 ``ckpt.commit``, ``ckpt.latest``; torn-write sites ``ckpt.truncate.model``
 / ``ckpt.truncate.optimizer``.
+
+The atomic-commit primitives here (``_write_manifest``, ``_commit_dir``,
+``_fsync_path``, ``verify_checkpoint``) are also the foundation of the
+serving cold tier (``inference/v2/coldstore.py``): each spilled KV block
+/ adapter pack becomes a tiny manifest-verified checkpoint, which is what
+makes replica warm state crash-durable and rehydratable.
 """
 
 from __future__ import annotations

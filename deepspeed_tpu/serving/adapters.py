@@ -50,8 +50,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..inference.v2.coldstore import ColdStore
 from ..inference.v2.engine import ADAPTER_TARGETS, adapter_target_shapes
-from ..inference.v2.paging import BlockPager
+from ..inference.v2.paging import BlockPager, deserialize_block
+from ..utils import faults
 from ..observability.recorder import recorder
 from ..observability.trace import tracer
 from ..utils.locks import named_lock
@@ -217,14 +219,17 @@ class AdapterRegistry:
     ``spill_dir`` mirror the KV pager knobs)."""
 
     def __init__(self, engine, host_bytes: int = 256 << 20,
-                 spill_dir: str = "", name: str = "replica0"):
+                 spill_dir: str = "", name: str = "replica0",
+                 coldstore_dir: str = ""):
         if getattr(engine, "adapter_stack", None) is None:
             raise AdapterError(
                 "AdapterRegistry needs an engine built with adapter_slots "
                 "(and adapter_rank) > 0")
         self.engine = engine
         self.name = name
-        self.pager = BlockPager(host_bytes, spill_dir=spill_dir)
+        cold = ColdStore(coldstore_dir) if coldstore_dir else None
+        self.pager = BlockPager(host_bytes, spill_dir=spill_dir,
+                                coldstore=cold)
         self._lock = named_lock("adapters.registry")
         self._entries: Dict[str, _Entry] = {}
         self._free: List[int] = list(range(1, engine.cfg.adapter_slots))
@@ -234,6 +239,54 @@ class AdapterRegistry:
         self.evictions = 0      # device->host demotions (slot reclaims)
         self.hits = 0           # acquire() found the adapter resident
         self.capacity_deferrals = 0
+        self.rehydrated = 0     # entries re-adopted from the cold store
+        if cold is not None:
+            self._rehydrate(cold)
+
+    # -- restart rehydration (construction time, pre-traffic) -------------
+
+    def _rehydrate(self, cold: ColdStore) -> None:
+        """Re-adopt adapter packs a crashed (or restarted) predecessor
+        spilled to the cold store: each surviving, manifest-verified entry
+        becomes a registered-but-cold entry (no device slot) that a later
+        ``acquire`` promotes through the normal path.  Entries with the
+        wrong geometry for this deployment are deleted, not adopted —
+        degrade to re-register, never to a wrong delta."""
+        sp = tracer.begin("coldstore/rehydrate_adapters", replica=self.name)
+        adopted = dropped = 0
+        for key, meta, nbytes in cold.entries():
+            if meta.get("kind") != "adapter_pack":
+                continue
+            faults.maybe_fail("serving.coldstore.rehydrate")
+            adapter_id = str(meta.get("adapter_id", ""))
+            payload = cold.read(key)  # verify-before-adopt; corrupt → GC'd
+            if payload is None or not adapter_id \
+                    or adapter_id in self._entries:
+                dropped += 1
+                continue
+            try:
+                pack = _pack_from_arrays(deserialize_block(payload))
+                self._check_pack(pack)
+            except (AdapterError, KeyError, ValueError):
+                cold.delete(key)  # wrong geometry for this deployment
+                dropped += 1
+                continue
+            handle = self.pager.adopt(key, nbytes, metadata=dict(meta))
+            if handle is None:
+                dropped += 1
+                continue
+            self._entries[adapter_id] = _Entry(adapter_id, handle,
+                                               int(meta.get("nbytes",
+                                                            nbytes)))
+            adopted += 1
+            recorder.record_event("adapter/rehydrate", replica=self.name,
+                                  adapter=adapter_id)
+        self.rehydrated = adopted
+        tracer.end(sp, adopted=adopted, dropped=dropped)
+        if adopted or dropped:
+            logger.info(f"adapters: {self.name} rehydrated {adopted} "
+                        f"adapter(s) from cold store "
+                        f"({dropped} dropped)")
 
     # -- registration (any thread; fleet control ops land here) ----------
 
@@ -261,13 +314,21 @@ class AdapterRegistry:
                                    "registered (retire it first)")
         # pager IO outside the registry lock; the entry is not yet visible
         arrays = _arrays_from_pack(pack)
-        put = self.pager.put(arrays, metadata={"adapter_id": adapter_id})
+        nbytes = sum(int(a.nbytes) for a in arrays.values())
+        # the durable identity: should this pack overflow to the cold
+        # store, a respawned registry finds it under its adapter id and
+        # re-adopts it (geometry in the meta gates cross-deploy reuse)
+        meta = {"kind": "adapter_pack", "adapter_id": adapter_id,
+                "adapter_rank": str(self.engine.cfg.adapter_rank),
+                "num_layers": str(self.engine.model_cfg.num_layers),
+                "nbytes": str(nbytes)}
+        put = self.pager.put(arrays, metadata=meta,
+                             durable_key=f"adapter-{adapter_id}")
         if put is None:
             raise AdapterError(
                 f"adapter host tier full registering {adapter_id!r} "
                 "(raise --adapter_host_pool_mb or set a spill dir)")
         handle, tier = put
-        nbytes = sum(int(a.nbytes) for a in arrays.values())
         with self._lock:
             if adapter_id in self._entries:  # raced a duplicate register
                 self.pager.drop(handle)
@@ -461,6 +522,10 @@ class AdapterRegistry:
             "capacity_deferrals": float(self.capacity_deferrals),
             "host_bytes_used": float(p["host_bytes_used"]),
             "spill_blocks": float(p["tier_spill_blocks"]),
+            # crash-durable cold tier (inference/v2/coldstore.py)
+            "cold_blocks": float(p.get("tier_cold_blocks", 0)),
+            "rehydrated": float(self.rehydrated),
+            "coldstore_entries": float(p.get("coldstore_entries", 0)),
         }
 
     def promote_wait_percentiles(self) -> Dict[str, float]:

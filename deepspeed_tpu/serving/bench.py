@@ -869,6 +869,216 @@ def run_paging_replay(seed: int = 0, requests: int = 24,
     }
 
 
+# -- crash-durable warm state: restart rehydration A/B (ISSUE 20) ----------
+
+
+def run_restart_replay(seed: int = 0, requests: int = 12,
+                       rate_rps: float = 8.0,
+                       resume_fraction: float = 0.5,
+                       idle_gap_s: float = 0.5,
+                       time_scale: float = 1.0,
+                       slo_path: Optional[str] = None,
+                       slo_workload: str = "rehydrate-smoke",
+                       model: str = "tiny", max_queue: int = 64,
+                       num_blocks: int = 20,
+                       kv_host_pool_bytes: int = 65536,
+                       state_root: str = "") -> dict:
+    """Restart-rehydration A/B for the crash-durable cold tier
+    (``--restart``).
+
+    The same seeded session/resume workload runs twice against a ONE-
+    replica subprocess pool under device+host memory pressure (tiny
+    device pool, a host pool of a few blocks, so demoted blocks overflow
+    into the bottom tier).  Between the base and resume waves the worker process
+    is SIGKILLed — no unwinding, no flush — and the supervisor respawns
+    it.  The rehydrate arm gives the worker a ``--kv_coldstore_dir``
+    root, so the respawned generation re-adopts its predecessor's
+    manifest-verified cold entries before serving; the cold-respawn arm
+    has no durable tier and comes back empty.
+
+    Records ``rehydrated_blocks`` (adopted by the new generation, the
+    tentpole gate), resume-wave hit-token rates for both arms and their
+    gain (rehydrate − cold respawn), resume-wave ``token_mismatches``
+    between the arms (greedy decode: a rehydrated prefix must never
+    change tokens, only skip prefill), and the post-drain process leak
+    count — gated by the ``rehydrate-smoke`` table in slo.toml.
+    """
+    import argparse
+    import dataclasses as _dc
+    import shutil
+    import tempfile
+
+    from ..observability import replay as rp
+    from .balancer import ReplicaPool
+    from .config import ServingConfig
+    from .server import (add_engine_cli_args, add_serving_cli_args,
+                         engine_argv_from_args, serving_argv_from_config)
+
+    template_len, suffix_len, block_size = 20, 4, 8
+    meta, wl = rp.synthesize_workload(seed=seed, num_requests=requests,
+                                      mean_rate_rps=rate_rps,
+                                      num_templates=6,
+                                      template_len=template_len,
+                                      suffix_len=suffix_len,
+                                      max_new_tokens=8,
+                                      resume_fraction=resume_fraction,
+                                      idle_gap_s=idle_gap_s)
+    base, resume = wl[:requests], wl[requests:]
+    if not resume:
+        raise rp.WorkloadError("resume_fraction produced no resume wave")
+    t_first = resume[0].offset_s
+    resume = [_dc.replace(r, offset_s=r.offset_s - t_first) for r in resume]
+    resume_prompt_tokens = sum(len(r.prompt) for r in resume)
+    slos = rp.load_slos(slo_path)
+    if slo_workload not in slos:
+        raise rp.SLOError(f"no [workloads.\"{slo_workload}\"] table in "
+                          f"{slo_path or rp.default_slo_path()}; have "
+                          f"{sorted(slos)}")
+
+    def _eargs(coldstore_dir: str):
+        argv = ["--model", model, "--seed", "0",
+                "--num_blocks", str(num_blocks),
+                "--max_tokens_per_step", "32", "--max_seqs", "4",
+                "--block_size", str(block_size),
+                "--max_blocks_per_seq", "8",
+                "--max_queue", str(max_queue), "--enable_prefix_cache",
+                "--kv_host_pool_bytes", str(kv_host_pool_bytes),
+                "--kv_promote_ahead"]
+        if coldstore_dir:
+            argv += ["--kv_coldstore_dir", coldstore_dir]
+        ep = argparse.ArgumentParser()
+        add_engine_cli_args(ep)
+        add_serving_cli_args(ep)
+        return ep.parse_args(argv)
+
+    def _wait_idle(pool, budget_s: float = 60.0) -> None:
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            if sum(t.num_running() for t in pool.replicas
+                   if t.healthy()) == 0 and pool.queue_depth() == 0:
+                return
+            time.sleep(0.2)
+
+    def one_leg(coldstore_dir: str) -> dict:
+        # ONE subprocess replica: the A/B contrasts what one worker's
+        # warm state survives across a hard kill, not routing — and the
+        # kill must be a real SIGKILL against a real process
+        cfg = ServingConfig(max_queue=max_queue, num_replicas=1,
+                            replica_transport="subprocess",
+                            heartbeat_interval_s=0.2,
+                            heartbeat_timeout_s=2.0,
+                            respawn_backoff_s=0.2,
+                            submit_timeout_s=120.0,
+                            spawn_timeout_s=300.0)
+        worker_argv = (engine_argv_from_args(_eargs(coldstore_dir))
+                       + serving_argv_from_config(cfg))
+        pool = ReplicaPool.build_subprocess(worker_argv, cfg)
+        pool.start()
+        pool.wait_ready()
+        leaked_procs = 0
+        try:
+            pool.submit([1, 2, 3], max_new_tokens=2).result(timeout=300)
+            out_base = rp.replay_workload(pool, base,
+                                          time_scale=time_scale)
+            _wait_idle(pool)
+            t = pool.replicas[0]
+            gen0 = t.generation
+            t._proc.kill()  # SIGKILL: no atexit, no flush, no unwinding
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                if t.generation > gen0 and t.healthy():
+                    break
+                time.sleep(0.2)
+            else:
+                raise RuntimeError(
+                    f"replica did not respawn within budget "
+                    f"(generation {t.generation}, healthy {t.healthy()})")
+            # warm the new process's compile cache so resume latencies
+            # measure serving, then snapshot the post-respawn stats
+            pool.submit([1, 2, 3], max_new_tokens=2).result(timeout=300)
+            s0 = t.prefix_stats()
+            out_resume = rp.replay_workload(pool, resume,
+                                            time_scale=time_scale)
+            _wait_idle(pool)
+            s1 = t.prefix_stats()
+        finally:
+            pool.drain()
+        leaked_procs = sum(
+            1 for r in pool.replicas
+            if getattr(r, "_proc", None) is not None
+            and r._proc.poll() is None)
+        skipped = s1.get("prefill_tokens_skipped", 0) \
+            - s0.get("prefill_tokens_skipped", 0)
+        return {
+            "base_summary": out_base["summary"],
+            "resume_summary": out_resume["summary"],
+            "resume_tokens": [r["tokens"] for r in out_resume["requests"]],
+            "resume_ok": [bool(r["ok"]) for r in out_resume["requests"]],
+            "resume_hit_token_rate": round(
+                float(skipped) / max(1, resume_prompt_tokens), 6),
+            "rehydrated_blocks": int(s0.get("rehydrated_blocks", 0)),
+            "coldstore_entries": int(s1.get("coldstore_entries", 0)),
+            "coldstore_corrupt_dropped":
+                int(s1.get("coldstore_corrupt_dropped", 0)),
+            "generations": t.generation + 1,
+            "leaked_procs": leaked_procs,
+        }
+
+    root = state_root or tempfile.mkdtemp(prefix="dstpu-rehydrate-bench-")
+    try:
+        rehydrate_leg = one_leg(root)
+        cold_leg = one_leg("")
+    finally:
+        if not state_root:
+            shutil.rmtree(root, ignore_errors=True)
+
+    # greedy decode: a rehydrated prefix may only SKIP prefill, never
+    # change tokens — compare resume streams pairwise where both arms
+    # delivered a terminal-ok stream
+    mismatches = sum(
+        1 for a, b, oka, okb in zip(rehydrate_leg["resume_tokens"],
+                                    cold_leg["resume_tokens"],
+                                    rehydrate_leg["resume_ok"],
+                                    cold_leg["resume_ok"])
+        if oka and okb and a != b)
+
+    summary = dict(rehydrate_leg["resume_summary"])
+    summary["rehydrated_blocks"] = rehydrate_leg["rehydrated_blocks"]
+    summary["restart_hit_rate"] = rehydrate_leg["resume_hit_token_rate"]
+    summary["restart_hit_gain"] = round(
+        rehydrate_leg["resume_hit_token_rate"]
+        - cold_leg["resume_hit_token_rate"], 6)
+    summary["token_mismatches"] = mismatches
+    summary["leaked_procs"] = (rehydrate_leg["leaked_procs"]
+                               + cold_leg["leaked_procs"])
+    violations = rp.check_slo(summary, slos[slo_workload], slo_workload)
+    return {
+        "subject": f"{model} model, JAX_PLATFORMS=cpu, session kill/respawn "
+                   f"replay: SIGKILL the single subprocess replica between "
+                   f"the base and resume waves ({num_blocks}-block device "
+                   f"pool, host {kv_host_pool_bytes} B) — cold-store "
+                   "rehydration A/B cold respawn on the identical seeded "
+                   "workload",
+        "workload_meta": meta,
+        "time_scale": time_scale,
+        "slo_workload": slo_workload,
+        "summary": summary,
+        "rehydrated_blocks": summary["rehydrated_blocks"],
+        "restart_hit_rate": summary["restart_hit_rate"],
+        "restart_hit_rate_cold_respawn": cold_leg["resume_hit_token_rate"],
+        "restart_hit_gain": summary["restart_hit_gain"],
+        "token_mismatches": mismatches,
+        "coldstore_entries": rehydrate_leg["coldstore_entries"],
+        "coldstore_corrupt_dropped":
+            rehydrate_leg["coldstore_corrupt_dropped"],
+        "generations": rehydrate_leg["generations"],
+        "base_summary": rehydrate_leg["base_summary"],
+        "cold_respawn_summary": cold_leg["resume_summary"],
+        "leaked_worker_processes_after_drain": summary["leaked_procs"],
+        "slo_violations": [v.to_dict() for v in violations],
+    }
+
+
 # -- multi-tenant adapter serving (ISSUE 19) -------------------------------
 
 
@@ -1247,6 +1457,16 @@ def main(argv=None) -> int:
                         "the host-DRAM paging tier (tiny device pool; "
                         "paging vs evict-only on the identical seeded "
                         "workload, gated by the paging-smoke SLO table)")
+    p.add_argument("--restart", action="store_true",
+                   help="replay: kill/respawn A/B for the crash-durable "
+                        "cold tier (SIGKILL the subprocess replica between "
+                        "the base and resume waves; rehydrate vs cold "
+                        "respawn on the identical seeded workload, gated "
+                        "by the rehydrate-smoke SLO table)")
+    p.add_argument("--state_root", default="",
+                   help="replay --restart: cold-store root for the "
+                        "rehydrate arm (default: a temp dir, removed "
+                        "afterwards)")
     p.add_argument("--resume_fraction", type=float, default=0.5,
                    help="replay --paging: resume-wave size as a fraction "
                         "of the base wave")
@@ -1285,6 +1505,16 @@ def main(argv=None) -> int:
             slo_workload=args.slo_workload or "adapters-smoke",
             max_queue=args.max_queue or 64)
         key = "adapters"
+    elif args.mode == "replay" and args.restart:
+        result = run_restart_replay(
+            seed=args.seed, requests=args.requests, rate_rps=rates[0],
+            resume_fraction=args.resume_fraction,
+            idle_gap_s=args.idle_gap_s, time_scale=args.time_scale,
+            slo_path=args.slo,
+            slo_workload=args.slo_workload or "rehydrate-smoke",
+            max_queue=args.max_queue or 64,
+            state_root=args.state_root)
+        key = "rehydrate"
     elif args.mode == "replay" and args.paging:
         result = run_paging_replay(
             seed=args.seed, requests=args.requests, rate_rps=rates[0],

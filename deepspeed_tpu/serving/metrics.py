@@ -155,8 +155,17 @@ class ServingMetrics:
         # dstpu_serving_kv_* names without double-emitting prefix_* keys.
         self.kv: Dict[str, float] = {
             "tier_device_blocks": 0, "tier_host_blocks": 0,
-            "tier_spill_blocks": 0, "demotions": 0, "promotions": 0,
-            "promote_wait_ms": 0.0,
+            "tier_spill_blocks": 0, "tier_cold_blocks": 0,
+            "demotions": 0, "promotions": 0,
+            "promote_wait_ms": 0.0, "rehydrated_blocks": 0,
+            "gc_spill_files": 0,
+        }
+        # crash-durable cold tier mirror (manifest-verified checkpoint
+        # store below the host pool, inference/v2/coldstore.py; summed
+        # over replicas by the pump; all zero without --kv_coldstore_dir)
+        self.coldstore: Dict[str, float] = {
+            "entries": 0, "bytes": 0, "writes": 0,
+            "corrupt_dropped": 0, "gc_tmp": 0,
         }
         # multi-adapter serving mirror (registry-owned gauges + paging
         # counters from serving/adapters.py, summed over replicas by the
@@ -166,6 +175,7 @@ class ServingMetrics:
             "loads": 0, "evictions": 0, "hits": 0,
             "capacity_deferrals": 0, "promote_wait_ms": 0.0,
             "host_bytes_used": 0, "spill_blocks": 0,
+            "cold_blocks": 0, "rehydrated": 0, "coldstore_entries": 0,
         }
         # speculative-decoding mirror (engine-owned counters, summed over
         # replicas by the pump; all zero when spec_mode is "off")
@@ -310,6 +320,9 @@ class ServingMetrics:
             for k in self.kv:
                 if k in stats:
                     self.kv[k] = stats[k]
+            for k in self.coldstore:
+                if "coldstore_" + k in stats:
+                    self.coldstore[k] = stats["coldstore_" + k]
 
     def set_adapter_stats(self, stats: Dict[str, float]) -> None:
         """Mirror adapter-registry stats (see
@@ -359,6 +372,8 @@ class ServingMetrics:
                 out[f"prefix_{k}"] = float(v)
             for k, v in self.kv.items():
                 out[f"kv_{k}"] = float(v)
+            for k, v in self.coldstore.items():
+                out[f"coldstore_{k}"] = float(v)
             for k, v in self.adapters.items():
                 out[f"adapter_{k}"] = float(v)
             for k, v in self.spec.items():
@@ -429,6 +444,10 @@ class ServingMetrics:
             b.gauge(f"{pre}kv_{k}",
                     f"KV memory hierarchy: {k.replace('_', ' ')}.",
                     snap[f"kv_{k}"])
+        for k in self.coldstore:
+            b.gauge(f"{pre}coldstore_{k}",
+                    f"Crash-durable cold tier: {k.replace('_', ' ')}.",
+                    snap[f"coldstore_{k}"])
         for k in self.adapters:
             b.gauge(f"{pre}adapter_{k}",
                     f"Multi-adapter serving: {k.replace('_', ' ')}.",

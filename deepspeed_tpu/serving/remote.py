@@ -435,7 +435,13 @@ class LocalWorkerLauncher:
     """Spawn dial-in workers on THIS host (loopback fleets, autoscaler
     scale-ups, tests).  Production topologies run the identical command
     line under their own scheduler; the registry cannot tell the
-    difference — that is the point."""
+    difference — that is the point.
+
+    Durable-state note: any --kv_coldstore_dir / --adapter_coldstore_dir
+    roots on worker_argv ride every spawn unchanged; each worker derives
+    a per-replica subdir from its --name with the generation suffix
+    stripped, so a re-registered generation of the same replica lands on
+    its predecessor's cold store and rehydrates warm state at boot."""
 
     def __init__(self, worker_argv: Sequence[str], config: ServingConfig,
                  extra_env: Optional[Dict[str, str]] = None):

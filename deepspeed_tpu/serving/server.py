@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -387,6 +388,20 @@ def create_server(pool: ReplicaPool, metrics: ServingMetrics,
 # -- deployment entrypoint -------------------------------------------------
 
 
+def replica_state_subdir(root: str, name: str) -> str:
+    """Per-replica namespace for durable on-disk state (cold store, spill
+    files): ``<root>/<base name>`` with any ``.g<N>`` respawn-generation
+    suffix stripped, so a respawned worker (``replica0.g2``) lands on the
+    SAME directory its crashed predecessor (``replica0.g1``) wrote — that
+    is what makes restart rehydration find the warm set — while distinct
+    replicas never share (no cross-replica handle aliasing or sweeps)."""
+    base, dot, gen = name.rpartition(".")
+    if base and gen.startswith("g") and gen[1:].isdigit():
+        name = base
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", name) or "replica"
+    return os.path.join(root, safe)
+
+
 def build_engine_factory(args) -> Callable[[], "object"]:
     """Engine factory from parsed engine CLI args (``add_engine_cli_args``).
     Shared by the HTTP front's in-process pool and the out-of-process
@@ -408,8 +423,10 @@ def build_engine_factory(args) -> Callable[[], "object"]:
                   prefix_cache_min_tokens=args.prefix_cache_min_tokens,
                   prefix_eviction=args.prefix_eviction,
                   kv_host_pool_mb=args.kv_host_pool_mb,
+                  kv_host_pool_bytes=getattr(args, "kv_host_pool_bytes", 0),
                   kv_spill_dir=args.kv_spill_dir,
                   kv_promote_ahead=args.kv_promote_ahead,
+                  kv_coldstore_dir=getattr(args, "kv_coldstore_dir", ""),
                   spec_mode=args.spec_mode, spec_k=args.spec_k,
                   quantize_bits=args.quantize_bits,
                   quantize_group=args.quantize_group,
@@ -464,13 +481,21 @@ def build_adapter_factory(args) -> Optional[Callable]:
         preload.append((aid, path))
     host_mb = getattr(args, "adapter_host_pool_mb", 256)
     spill_dir = getattr(args, "adapter_spill_dir", "") or ""
+    cold_root = getattr(args, "adapter_coldstore_dir", "") or ""
 
     def factory(engine, name: str):
         from .adapters import AdapterRegistry
 
+        # durable adapter state is namespaced per replica (generation
+        # suffix stripped) so a respawned worker rehydrates its own
+        # predecessor's cold packs and nobody else's
+        cold = replica_state_subdir(cold_root, name) if cold_root else ""
         reg = AdapterRegistry(engine, host_bytes=host_mb << 20,
-                              spill_dir=spill_dir, name=name)
+                              spill_dir=spill_dir, name=name,
+                              coldstore_dir=cold)
         for aid, path in preload:
+            if reg.known(aid):
+                continue  # already rehydrated from the cold store
             reg.register(aid, ckpt_dir=path)
         return reg
 
@@ -498,10 +523,16 @@ def engine_argv_from_args(args) -> List[str]:
         argv.append("--enable_prefix_cache")
     if args.kv_host_pool_mb:
         argv += ["--kv_host_pool_mb", str(args.kv_host_pool_mb)]
+    if getattr(args, "kv_host_pool_bytes", 0):
+        argv += ["--kv_host_pool_bytes", str(args.kv_host_pool_bytes)]
     if args.kv_spill_dir:
         argv += ["--kv_spill_dir", args.kv_spill_dir]
     if args.kv_promote_ahead:
         argv.append("--kv_promote_ahead")
+    if getattr(args, "kv_coldstore_dir", ""):
+        # the ROOT rides respawn argv unchanged; each worker derives its
+        # per-replica subdir from its own --name (replica_state_subdir)
+        argv += ["--kv_coldstore_dir", args.kv_coldstore_dir]
     if args.spec_draft_model:
         argv += ["--spec_draft_model", args.spec_draft_model]
     if args.spec_draft_seed is not None:
@@ -512,6 +543,8 @@ def engine_argv_from_args(args) -> List[str]:
                  "--adapter_host_pool_mb", str(args.adapter_host_pool_mb)]
         if args.adapter_spill_dir:
             argv += ["--adapter_spill_dir", args.adapter_spill_dir]
+        if getattr(args, "adapter_coldstore_dir", ""):
+            argv += ["--adapter_coldstore_dir", args.adapter_coldstore_dir]
         if args.adapter_preload:
             argv += ["--adapter_preload", args.adapter_preload]
     return argv
@@ -618,6 +651,11 @@ def add_engine_cli_args(p) -> None:
                         "instead of evicting them, so a returning session "
                         "promotes KV back instead of recomputing prefill "
                         "(0 = off; needs --enable_prefix_cache)")
+    p.add_argument("--kv_host_pool_bytes", type=int, default=0,
+                   help="exact-bytes override of --kv_host_pool_mb "
+                        "(tests/benches sizing the host pool below one MiB "
+                        "to force bottom-tier overflow; 0 = use the MiB "
+                        "knob)")
     p.add_argument("--kv_spill_dir", default="",
                    help="third memory tier: when the host pool overflows, "
                         "spill its oldest blocks to safetensors files in "
@@ -626,6 +664,13 @@ def add_engine_cli_args(p) -> None:
                    help="background thread prefetches spilled blocks into "
                         "host DRAM as soon as a request referencing them is "
                         "queued, overlapping disk reads with earlier steps")
+    p.add_argument("--kv_coldstore_dir", default="",
+                   help="crash-durable cold tier: host-pool overflow lands "
+                        "as manifest-verified committed entries under this "
+                        "root (replacing bare spill files), and a respawned "
+                        "worker rehydrates surviving entries into its radix "
+                        "tree at boot; worker transports derive a "
+                        "per-replica subdir from the worker name")
     p.add_argument("--quantize_bits", type=int, default=0,
                    choices=[0, 4, 6, 8],
                    help="weight-only quantization of the served base: "
@@ -670,6 +715,11 @@ def add_engine_cli_args(p) -> None:
     p.add_argument("--adapter_spill_dir", default="",
                    help="spill tier for the adapter host pool: overflow "
                         "adapters land in safetensors files here")
+    p.add_argument("--adapter_coldstore_dir", default="",
+                   help="crash-durable cold tier for adapter factor packs "
+                        "(per-replica subdirs, manifest-verified); a "
+                        "respawned worker re-registers surviving packs "
+                        "without re-loading their checkpoints")
     p.add_argument("--adapter_preload", default=None,
                    help="comma-separated ID=CKPT_DIR adapter checkpoints "
                         "registered into every replica at startup (later "

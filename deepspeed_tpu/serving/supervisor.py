@@ -224,4 +224,14 @@ class ReplicaSupervisor:
             return
         if now >= r.next_respawn_at:
             r.next_respawn_at = 0.0
+            argv = getattr(r, "worker_argv", None) or ()
+            if "--kv_coldstore_dir" in argv:
+                # the new generation inherits its predecessor's cold-store
+                # root on argv and rehydrates surviving warm state at boot
+                logger.info(f"supervisor: respawning {r.name} with "
+                            "crash-durable warm state (cold-store "
+                            "rehydration)")
+                tracer.add_event("replica/respawn_rehydrate",
+                                 attrs={"replica": r.name,
+                                        "generation": r.generation + 1})
             r.respawn()

@@ -521,9 +521,26 @@ def main(argv: Optional[list] = None) -> int:
         slo_classes=parse_slo_classes(args.slo_classes),
         default_slo_class=args.default_slo_class)
     logger.info(f"worker {args.name}: building engine (model={args.model})")
-    from .server import build_adapter_factory
+    from .server import build_adapter_factory, replica_state_subdir
+
+    # Namespace durable state per replica: the launcher passes the RAW
+    # roots on argv (unchanged across respawns) and each worker derives
+    # its own subdir from --name.  Generations of the same replica
+    # ("replica0.g0", "replica0.g1") map to the same subdir, so a
+    # respawned worker finds its predecessor's cold store and can
+    # rehydrate.  adapter_coldstore_dir is NOT rewritten here — the
+    # adapter factory namespaces it internally (it also serves the
+    # in-process path).
+    for attr in ("kv_coldstore_dir", "kv_spill_dir", "adapter_spill_dir"):
+        root = getattr(args, attr, "") or ""
+        if root:
+            setattr(args, attr, replica_state_subdir(root, args.name))
 
     engine = build_engine_factory(args)()
+    rehydrated = engine.rehydrate_coldstore()
+    if rehydrated.get("adopted") or rehydrated.get("skipped"):
+        logger.info(f"worker {args.name}: cold-store rehydrate "
+                    f"{rehydrated}")
     adapter_factory = build_adapter_factory(args)
     adapters = (adapter_factory(engine, args.name)
                 if adapter_factory is not None else None)

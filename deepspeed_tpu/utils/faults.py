@@ -33,6 +33,14 @@ Spec grammar, per site: ``KIND[:ARG][@HIT]``
 
 Tests can assert on ``faults.hits(site)`` / ``faults.fired(site)``.
 
+Sites are free-form strings — new subsystems add sites without touching
+this module.  The serving cold tier (``inference/v2/coldstore.py``)
+compiles in ``serving.coldstore.write`` (before staging; also the
+``maybe_truncate`` torn-write point on the staged payload),
+``serving.coldstore.commit`` (between manifest write and the atomic
+rename — a kill here leaves a ``.tmp`` orphan for startup GC), and
+``serving.coldstore.rehydrate`` (per entry during restart rehydration).
+
 Crash hooks: callables registered with :func:`add_crash_hook` run just
 before an ``exit`` spec's ``os._exit`` — the flight recorder
 (``observability/recorder.py``) uses this to leave a postmortem dump on

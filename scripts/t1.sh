@@ -192,6 +192,18 @@ then
     exit 2
 fi
 
+# rehydration suite: imports the crash-durable cold tier (inference/v2/
+# coldstore.py), the restart rehydration paths (engine + adapter
+# registry), and the fault-injection harness
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_rehydrate.py -q --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly >> /tmp/_t1_collect.log 2>&1
+then
+    echo "t1: test_rehydrate.py COLLECTION FAILED" >&2
+    tail -30 /tmp/_t1_collect.log >&2
+    exit 2
+fi
+
 if [ "${1:-}" = "--collect" ]; then
     exit 0
 fi
@@ -221,9 +233,12 @@ T1_GROUPS=${T1_GROUPS:-6}
 # test_adapters likewise: the adapter registry lock nests against the
 # broker/engine/pager locks on the admission and retire paths, so the
 # multi-tenant suite is lock-order-checked on every CI run.
+# test_rehydrate likewise: the cold-store counter lock nests against the
+# pager/prefix-cache/broker locks on the demote and rehydrate paths, and
+# its fleet test SIGKILLs a live worker — lock-order-checked every run.
 mapfile -t T1_FILES < <(ls tests/test_*.py \
     | grep -v -e 'test_remote_fleet' -e 'test_disagg' -e 'test_fleet\.py' \
-        -e 'test_paging' -e 'test_adapters' \
+        -e 'test_paging' -e 'test_adapters' -e 'test_rehydrate' \
     | sort)
 rc=0
 rm -f /tmp/_t1.log
@@ -276,6 +291,15 @@ fi
 echo "== t1: group adapters (lockdep): tests/test_adapters.py =="
 timeout -k 10 1800 env JAX_PLATFORMS=cpu DSTPU_LOCKDEP=1 \
     python -m pytest tests/test_adapters.py -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
+grc=${PIPESTATUS[0]}
+if [ "$grc" -ne 0 ] && [ "$grc" -ne 5 ]; then
+    rc=$grc
+fi
+echo "== t1: group rehydrate (lockdep): tests/test_rehydrate.py =="
+timeout -k 10 1800 env JAX_PLATFORMS=cpu DSTPU_LOCKDEP=1 \
+    python -m pytest tests/test_rehydrate.py -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee -a /tmp/_t1.log
 grc=${PIPESTATUS[0]}

@@ -1,0 +1,604 @@
+"""Crash-durable warm state: checkpoint-store cold tier + replica restart
+rehydration (ISSUE 20).
+
+The contract under test: warm serving state (demoted KV prefix blocks and
+adapter packs) that overflows the host pool lands in a manifest-verified
+cold store built on the ``runtime/checkpoint`` tmp→fsync→rename
+discipline, and a respawned worker re-adopts what survived — resumed
+sessions are token-identical to the uncached oracle *with* rehydrated
+cache hits, and a torn/corrupt/tampered entry degrades to re-prefill,
+never to wrong tokens.  Around that oracle: ColdStore atomicity under
+injected faults at every ``serving.coldstore.*`` site (including
+subprocess hard kills), startup GC of ``.tmp`` staging and orphaned bare
+spill files, pager cold-tier bookkeeping, adapter-registry rehydration,
+metrics exposition, and an end-to-end fleet test that SIGKILLs a live
+worker mid-stream and drains leak-free.
+
+The whole file also runs under ``DSTPU_LOCKDEP=1`` in its own tier-1
+partition (scripts/t1.sh): the cold store's counter lock is
+order-checked against the pager, prefix-cache, and broker locks on
+every CI run.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.coldstore import PAYLOAD, ColdStore, sanitize_key
+from deepspeed_tpu.inference.v2.engine import InferenceEngineV2, V2Config
+from deepspeed_tpu.inference.v2.paging import (BlockPager, deserialize_block,
+                                               serialize_block)
+from deepspeed_tpu.models import transformer as tfm
+from deepspeed_tpu.serving import ReplicaPool, ServingConfig, ServingMetrics
+from deepspeed_tpu.serving.adapters import AdapterRegistry
+from deepspeed_tpu.utils import faults
+
+from tests.test_fleet import wait_until
+
+V2 = dict(max_tokens_per_step=32, max_seqs=4, block_size=8, num_blocks=64,
+          max_blocks_per_seq=8, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tfm.get_config("tiny", dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def ref_fn(tiny_model):
+    """Greedy continuation via the plain uncached forward — the reference
+    every rehydrated decode must match token-for-token."""
+    cfg, params = tiny_model
+    cache = {}
+
+    def ref(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in cache:
+            seq = np.array([list(prompt)], np.int32)
+            for _ in range(n):
+                logits = tfm.forward(params, seq, cfg)
+                nxt = np.asarray(logits[:, -1].argmax(-1)).astype(np.int32)
+                seq = np.concatenate([seq, nxt[:, None]], axis=1)
+            cache[key] = seq[0, len(prompt):].tolist()
+        return cache[key]
+
+    return ref
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _engine(tiny_model, **over):
+    cfg, params = tiny_model
+    return InferenceEngineV2(
+        cfg, params, V2Config(**{**V2, "enable_prefix_cache": True, **over}))
+
+
+def _assert_consistent(eng, idle=True):
+    eng.prefix_cache.check_consistency()
+    free, ev, pin, tot = (eng.free_blocks, eng.evictable_blocks,
+                          eng.pinned_blocks, eng.total_blocks)
+    assert free + ev + pin == tot, (free, ev, pin, tot)
+    if idle:
+        assert pin == 0, f"{pin} blocks pinned with no live sequence"
+
+
+def _run_session(eng, prompts, ref, n=8):
+    """Prefill+decode each prompt and check greedy token identity."""
+    uids = {tuple(p): eng.put(list(p), max_new_tokens=n) for p in prompts}
+    done = eng.generate_all()
+    for p in prompts:
+        got = [int(t) for t in done[uids[tuple(p)]][len(p):]]
+        assert got == ref(p, n), f"prompt {p}"
+
+
+def _seed_cold_root(tiny_model, ref, root, prompts):
+    """Engine A: run a session, demote everything to the cold tier, close
+    gracefully (graceful close must NOT delete cold entries)."""
+    eng = _engine(tiny_model, kv_host_pool_bytes=1, kv_coldstore_dir=root)
+    _run_session(eng, prompts, ref)
+    eng.prefix_cache.evict(100)  # demote every evictable chunk
+    stats = eng.prefix_stats()
+    assert stats["tier_cold_blocks"] > 0
+    assert stats["coldstore_entries"] > 0
+    eng.close()
+    return ColdStore(root).entries()
+
+
+P1 = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12]
+P2 = [21, 22, 23, 24, 25, 26, 27, 28, 29, 30, 31, 32]
+
+
+# ---------------------------------------------------------------------------
+# ColdStore: atomic commit, verify-before-adopt, startup GC (no model)
+# ---------------------------------------------------------------------------
+
+
+def test_coldstore_roundtrip_entries_meta_delete(tmp_path):
+    cs = ColdStore(str(tmp_path))
+    payload = os.urandom(256)
+    cs.write("kv-abc123", payload, {"kind": "kv_block", "tokens": "1,2"})
+    assert cs.read("kv-abc123") == payload
+    assert cs.meta("kv-abc123") == {"kind": "kv_block", "tokens": "1,2"}
+    [(key, meta, nbytes)] = cs.entries()
+    assert key == "kv-abc123" and nbytes == 256
+    assert meta["kind"] == "kv_block"
+    # re-write replaces atomically
+    cs.write("kv-abc123", b"x" * 8, {"kind": "kv_block"})
+    assert cs.read("kv-abc123") == b"x" * 8
+    st = cs.stats()
+    assert st["coldstore_entries"] == 1 and st["coldstore_writes"] == 2
+    assert st["coldstore_bytes"] == 8
+    cs.delete("kv-abc123")
+    assert cs.read("kv-abc123") is None
+    assert cs.entries() == []
+
+
+def test_coldstore_key_sanitization():
+    assert sanitize_key("kv-ab/../c") == "kv-ab_.._c"
+    for bad in ("", ".hidden", "x.tmp"):
+        with pytest.raises(ValueError):
+            sanitize_key(bad)
+
+
+def test_coldstore_bitflip_detected_and_dropped(tmp_path):
+    cs = ColdStore(str(tmp_path))
+    cs.write("kv-deadbeef", b"A" * 128, {"kind": "kv_block"})
+    ppath = os.path.join(cs.path("kv-deadbeef"), PAYLOAD)
+    with open(ppath, "rb+") as f:
+        f.seek(64)
+        f.write(b"B")  # single flipped byte
+    # verify-before-adopt: corrupt entry returns None AND is deleted, so
+    # the caller's degrade-to-recompute is permanent
+    assert cs.read("kv-deadbeef") is None
+    assert not os.path.exists(cs.path("kv-deadbeef"))
+    assert cs.stats()["coldstore_corrupt_dropped"] == 1
+
+
+def test_coldstore_torn_write_caught_by_manifest(tmp_path):
+    cs = ColdStore(str(tmp_path))
+    # the truncate fires AFTER the manifest recorded the full payload's
+    # digest — the committed entry is torn, and read() must catch it
+    faults.configure({"serving.coldstore.write": "truncate:16"})
+    cs.write("kv-torn", b"T" * 200, {"kind": "kv_block"})
+    faults.reset()
+    assert os.path.isdir(cs.path("kv-torn"))  # committed, but torn
+    assert cs.read("kv-torn") is None
+    assert cs.stats()["coldstore_corrupt_dropped"] == 1
+
+
+def test_coldstore_commit_fault_leaves_tmp_for_startup_gc(tmp_path):
+    root = str(tmp_path)
+    cs = ColdStore(root)
+    faults.configure({"serving.coldstore.commit": "ioerror"})
+    with pytest.raises(IOError):
+        cs.write("kv-halfway", b"H" * 64, {"kind": "kv_block"})
+    faults.reset()
+    # the manifest+payload were staged but never committed
+    assert os.path.isdir(os.path.join(root, "kv-halfway.tmp"))
+    assert cs.entries() == []
+    # next boot sweeps the uncommitted staging dir (counted)
+    cs2 = ColdStore(root)
+    assert cs2.stats()["coldstore_gc_tmp"] == 1
+    assert not os.path.exists(os.path.join(root, "kv-halfway.tmp"))
+    assert cs2.entries() == []
+
+
+def test_coldstore_write_fault_stages_nothing(tmp_path):
+    cs = ColdStore(str(tmp_path))
+    faults.configure({"serving.coldstore.write": "ioerror"})
+    with pytest.raises(IOError):
+        cs.write("kv-early", b"E" * 32, {"kind": "kv_block"})
+    faults.reset()
+    assert os.listdir(str(tmp_path)) == []
+
+
+def test_sigkill_at_write_and_commit_sites(tmp_path):
+    """Hard os._exit at each durability fault site in a real subprocess:
+    a kill before staging leaves nothing; a kill between manifest and
+    rename leaves only a .tmp orphan the next boot GCs."""
+    root = str(tmp_path)
+    script = textwrap.dedent("""\
+        import sys
+        from deepspeed_tpu.inference.v2.coldstore import ColdStore
+        cs = ColdStore(sys.argv[1])
+        cs.write("kv-victim", b"V" * 64, {"kind": "kv_block"})
+        sys.exit(3)  # unreachable when the armed site fires
+    """)
+    for site, leftovers in (("serving.coldstore.write", []),
+                            ("serving.coldstore.commit", ["kv-victim.tmp"])):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "DSTPU_FAULTS": f"{site}=exit:70"}
+        res = subprocess.run([sys.executable, "-c", script, root],
+                             env=env, capture_output=True, text=True,
+                             timeout=300)
+        assert res.returncode == 70, res.stderr
+        assert sorted(os.listdir(root)) == leftovers
+    # respawn boot: the commit-site orphan is swept, nothing is adopted
+    cs = ColdStore(root)
+    assert cs.stats()["coldstore_gc_tmp"] == 1
+    assert cs.entries() == [] and os.listdir(root) == []
+
+
+# ---------------------------------------------------------------------------
+# BlockPager cold tier: durable keys, adopt, startup sweeps (no model)
+# ---------------------------------------------------------------------------
+
+
+def test_pager_cold_tier_put_get_drop(tmp_path):
+    pg = BlockPager(host_bytes=1, coldstore=ColdStore(str(tmp_path)))
+    arrays = {"k": np.arange(64, dtype=np.float32).reshape(4, 16)}
+    handle, tier = pg.put(arrays, metadata={"kind": "kv_block"},
+                          durable_key="kv-feedface")
+    assert tier == "cold" and pg.cold_blocks == 1 and pg.spill_blocks == 0
+    assert np.array_equal(pg.get(handle)["k"], arrays["k"])
+    st = pg.stats()
+    assert st["tier_cold_blocks"] == 1 and st["coldstore_entries"] == 1
+    # drop releases the durable entry too (the block was promoted or
+    # truly evicted — either way it must not leak on disk)
+    pg.drop(handle)
+    assert pg.get(handle) is None
+    assert pg.stats()["coldstore_entries"] == 0
+    pg.close()
+
+
+def test_pager_adopt_is_bookkeeping_only(tmp_path):
+    cs = ColdStore(str(tmp_path))
+    payload = serialize_block({"k": np.ones((2, 8), np.float32)},
+                              {"kind": "kv_block"})
+    cs.write("kv-survivor", payload, {"kind": "kv_block"})
+    writes0 = cs.stats()["coldstore_writes"]
+    pg = BlockPager(host_bytes=1 << 20, coldstore=cs)
+    handle = pg.adopt("kv-survivor", len(payload))
+    assert handle is not None and pg.rehydrated == 1
+    assert cs.stats()["coldstore_writes"] == writes0  # no rewrite
+    back = pg.get(handle)
+    assert np.array_equal(back["k"], np.ones((2, 8), np.float32))
+    # without a cold store there is nothing to adopt from
+    assert BlockPager(host_bytes=1).adopt("kv-survivor") is None
+    pg.close()
+
+
+def test_pager_sweeps_orphaned_spill_files(tmp_path):
+    # a crashed predecessor's bare spill files are dead: their handle
+    # numbers died with the process, and a fresh pager re-numbers from 1
+    for h in (3, 9):
+        with open(tmp_path / f"kvblock-{h}.safetensors", "wb") as f:
+            f.write(b"dead")
+    (tmp_path / "unrelated.txt").write_text("keep me")
+    pg = BlockPager(host_bytes=1 << 20, spill_dir=str(tmp_path))
+    assert pg.gc_spill_files == 2
+    assert sorted(os.listdir(tmp_path)) == ["unrelated.txt"]
+    pg.close()
+
+
+# ---------------------------------------------------------------------------
+# engine restart rehydration: token identity against the uncached oracle
+# ---------------------------------------------------------------------------
+
+
+def test_engine_restart_rehydrates_token_identical(tiny_model, ref_fn,
+                                                   tmp_path):
+    root = str(tmp_path)
+    entries = _seed_cold_root(tiny_model, ref_fn, root, [P1, P2])
+    assert len(entries) >= 2
+
+    # "respawned worker": a fresh engine over the surviving root
+    eng = _engine(tiny_model, kv_host_pool_bytes=1, kv_coldstore_dir=root)
+    r = eng.rehydrate_coldstore()
+    assert r["adopted"] == len(entries)
+    assert r["skipped"] == 0 and r["orphaned"] == 0
+    stats = eng.prefix_stats()
+    assert stats["rehydrated_blocks"] == len(entries)
+    assert stats["tier_cold_blocks"] == len(entries)
+
+    # the resumed session promotes instead of re-prefilling, and stays
+    # token-identical to the uncached greedy oracle
+    _run_session(eng, [P1, P2], ref_fn)
+    stats = eng.prefix_stats()
+    assert stats["prefill_tokens_skipped"] >= 16  # one full block each
+    assert stats["promotions"] > 0
+    _assert_consistent(eng)
+    eng.close()
+
+
+def test_engine_rehydrate_idempotent_and_noop_safe(tiny_model, ref_fn,
+                                                   tmp_path):
+    # no cold store configured → structured no-op
+    eng = _engine(tiny_model)
+    assert eng.rehydrate_coldstore() == {"adopted": 0, "orphaned": 0,
+                                         "skipped": 0}
+    root = str(tmp_path)
+    entries = _seed_cold_root(tiny_model, ref_fn, root, [P1])
+    eng2 = _engine(tiny_model, kv_host_pool_bytes=1, kv_coldstore_dir=root)
+    assert eng2.rehydrate_coldstore()["adopted"] == len(entries)
+    # a second pass adopts nothing new (every chain already in the tree);
+    # the unwound duplicates must not delete the originals' entries
+    r2 = eng2.rehydrate_coldstore()
+    assert r2["adopted"] == 0
+    _run_session(eng2, [P1], ref_fn)
+    assert eng2.prefix_stats()["prefill_tokens_skipped"] >= 8
+    _assert_consistent(eng2)
+    eng2.close()
+
+
+def test_engine_rehydrate_corrupt_parent_degrades_to_prefill(
+        tiny_model, ref_fn, tmp_path):
+    root = str(tmp_path)
+    entries = _seed_cold_root(tiny_model, ref_fn, root, [P1, P2])
+    # corrupt the SHALLOWEST chain (a parent block): rehydrate must skip
+    # it AND orphan its child — and the session must re-prefill to the
+    # right tokens, never consume the corruption
+    parent = min(entries, key=lambda e: len(e[1].get("tokens", "")))
+    ppath = os.path.join(root, parent[0], PAYLOAD)
+    size = os.path.getsize(ppath)
+    with open(ppath, "rb+") as f:
+        f.seek(size // 2)
+        f.write(b"\xff")
+
+    eng = _engine(tiny_model, kv_host_pool_bytes=1, kv_coldstore_dir=root)
+    r = eng.rehydrate_coldstore()
+    assert r["skipped"] >= 1, r     # the corrupt parent
+    assert r["orphaned"] >= 1, r    # its unreachable child
+    assert r["adopted"] == len(entries) - r["skipped"] - r["orphaned"]
+    assert eng.pager.coldstore.corrupt_dropped >= 1
+    assert not os.path.exists(os.path.join(root, parent[0]))
+    _run_session(eng, [P1, P2], ref_fn)
+    _assert_consistent(eng)
+    eng.close()
+
+
+def test_engine_rehydrate_rejects_tampered_meta(tiny_model, ref_fn,
+                                                tmp_path):
+    root = str(tmp_path)
+    entries = _seed_cold_root(tiny_model, ref_fn, root, [P1])
+    victim = entries[0][0]
+    mpath = os.path.join(root, victim, "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    # tamper: claim a different token chain (same length, same geometry).
+    # The key is content-derived, so the recomputed digest cannot match —
+    # adopting this would serve wrong tokens as a cache hit.
+    toks = [int(t) for t in manifest["meta"]["tokens"].split(",")]
+    toks[0] = (toks[0] + 1) % 250
+    manifest["meta"]["tokens"] = ",".join(str(t) for t in toks)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+
+    eng = _engine(tiny_model, kv_host_pool_bytes=1, kv_coldstore_dir=root)
+    r = eng.rehydrate_coldstore()
+    assert r["skipped"] >= 1
+    assert not os.path.exists(os.path.join(root, victim))  # deleted, not kept
+    _run_session(eng, [P1], ref_fn)
+    _assert_consistent(eng)
+    eng.close()
+
+
+def test_engine_rehydrate_rejects_wrong_geometry(tiny_model, ref_fn,
+                                                 tmp_path):
+    root = str(tmp_path)
+    entries = _seed_cold_root(tiny_model, ref_fn, root, [P1])
+    # a redeploy with a different block size must not adopt the old chains
+    eng = _engine(tiny_model, block_size=4, max_blocks_per_seq=16,
+                  kv_host_pool_bytes=1, kv_coldstore_dir=root)
+    r = eng.rehydrate_coldstore()
+    assert r["adopted"] == 0 and r["skipped"] == len(entries)
+    assert ColdStore(root).entries() == []  # deleted, not retried forever
+    _run_session(eng, [P1], ref_fn)
+    eng.close()
+
+
+def test_sigkill_mid_rehydrate_then_full_recovery(tiny_model, ref_fn,
+                                                  tmp_path):
+    """Hard kill at the serving.coldstore.rehydrate site (second entry) in
+    a real subprocess: adoption is bookkeeping-only, so the killed boot
+    must leave every committed entry intact for the next one."""
+    root = str(tmp_path)
+    entries = _seed_cold_root(tiny_model, ref_fn, root, [P1, P2])
+    assert len(entries) >= 2
+    script = textwrap.dedent("""\
+        import sys
+        import jax
+        from deepspeed_tpu.inference.v2.engine import (InferenceEngineV2,
+                                                       V2Config)
+        from deepspeed_tpu.models import transformer as tfm
+        cfg = tfm.get_config("tiny", dtype="float32")
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        eng = InferenceEngineV2(cfg, params, V2Config(
+            max_tokens_per_step=32, max_seqs=4, block_size=8, num_blocks=64,
+            max_blocks_per_seq=8, dtype="float32", enable_prefix_cache=True,
+            kv_host_pool_bytes=1, kv_coldstore_dir=sys.argv[1]))
+        eng.rehydrate_coldstore()
+        sys.exit(3)  # unreachable: the armed site fires on entry #2
+    """)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "DSTPU_FAULTS": "serving.coldstore.rehydrate=exit:70@2"}
+    res = subprocess.run([sys.executable, "-c", script, root], env=env,
+                         capture_output=True, text=True, timeout=540)
+    assert res.returncode == 70, res.stderr
+    # every entry survived the killed boot; the next one adopts them all
+    eng = _engine(tiny_model, kv_host_pool_bytes=1, kv_coldstore_dir=root)
+    r = eng.rehydrate_coldstore()
+    assert r["adopted"] == len(entries), (r, res.stderr)
+    _run_session(eng, [P1, P2], ref_fn)
+    assert eng.prefix_stats()["prefill_tokens_skipped"] >= 16
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# adapter packs: registry construction re-adopts surviving cold entries
+# ---------------------------------------------------------------------------
+
+
+def _make_pack(model_cfg, i, rank=4):
+    from deepspeed_tpu.inference.v2.engine import adapter_target_shapes
+    rng = np.random.default_rng(1000 + i)
+    L = model_cfg.num_layers
+    pack = {}
+    for target, (K, N) in adapter_target_shapes(model_cfg).items():
+        a = (rng.standard_normal((L, K, rank)) / np.sqrt(K)).astype(np.float32)
+        b = (0.5 * rng.standard_normal((L, rank, N))).astype(np.float32)
+        pack[target] = (a, b)
+    return pack
+
+
+def test_adapter_registry_rehydrates_packs(tiny_model, tmp_path):
+    root = str(tmp_path)
+    eng = _engine(tiny_model, adapter_slots=4, adapter_rank=4)
+    pack = _make_pack(eng.model_cfg, 0)
+    reg = AdapterRegistry(eng, host_bytes=1, coldstore_dir=root)
+    reg.register("tenant-a", pack=pack)
+    assert reg.stats()["cold_blocks"] == 1  # host_bytes=1 forced it cold
+    reg.close()
+
+    # "respawned worker": a fresh registry over the same root finds the
+    # pack under its durable adapter id — registered-but-cold, byte-exact
+    # through the normal acquire/promote path
+    reg2 = AdapterRegistry(eng, host_bytes=1, coldstore_dir=root)
+    assert reg2.rehydrated == 1 and reg2.known("tenant-a")
+    assert reg2.stats()["rehydrated"] == 1
+    back = reg2.get_pack("tenant-a")
+    assert sorted(back) == sorted(pack)
+    for target in pack:
+        assert np.array_equal(back[target][0], pack[target][0])
+        assert np.array_equal(back[target][1], pack[target][1])
+    slot = reg2.acquire("tenant-a")
+    assert slot >= 1
+    reg2.release("tenant-a")
+    # corrupt cold pack: next registry drops it and degrades to
+    # re-register (never a wrong delta)
+    ppath = os.path.join(root, "adapter-tenant-a", PAYLOAD)
+    with open(ppath, "rb+") as f:
+        f.seek(10)
+        f.write(b"\x7f")
+    reg2.close()
+    reg3 = AdapterRegistry(eng, host_bytes=1, coldstore_dir=root)
+    assert reg3.rehydrated == 0 and not reg3.known("tenant-a")
+    reg3.register("tenant-a", pack=pack)  # re-register heals
+    assert reg3.known("tenant-a")
+    reg3.close()
+
+
+# ---------------------------------------------------------------------------
+# metrics exposition: the rehydration gauges ride snapshot + /metrics
+# ---------------------------------------------------------------------------
+
+
+def test_serving_metrics_expose_coldstore_gauges():
+    m = ServingMetrics()
+    m.set_prefix_stats({"tier_cold_blocks": 3, "rehydrated_blocks": 2,
+                        "gc_spill_files": 1, "coldstore_entries": 5,
+                        "coldstore_bytes": 4096, "coldstore_writes": 7,
+                        "coldstore_corrupt_dropped": 1, "coldstore_gc_tmp": 2})
+    m.set_adapter_stats({"rehydrated": 1, "cold_blocks": 1,
+                         "coldstore_entries": 1})
+    snap = m.snapshot()
+    assert snap["kv_tier_cold_blocks"] == 3
+    assert snap["kv_rehydrated_blocks"] == 2
+    assert snap["kv_gc_spill_files"] == 1
+    assert snap["coldstore_entries"] == 5
+    assert snap["coldstore_corrupt_dropped"] == 1
+    assert snap["coldstore_gc_tmp"] == 2
+    assert snap["adapter_rehydrated"] == 1
+    text = m.to_prometheus()
+    for name in ("dstpu_serving_kv_tier_cold_blocks 3",
+                 "dstpu_serving_kv_rehydrated_blocks 2",
+                 "dstpu_serving_coldstore_entries 5",
+                 "dstpu_serving_coldstore_corrupt_dropped 1",
+                 "dstpu_serving_adapter_rehydrated 1"):
+        assert name in text, name
+
+
+# ---------------------------------------------------------------------------
+# the fleet: SIGKILL a live worker, respawn rehydrates warm state
+# ---------------------------------------------------------------------------
+
+
+FLEET_PROMPTS = [[10 * i + j for j in range(1, 13)] for i in range(1, 7)]
+
+
+def test_fleet_sigkill_respawn_rehydrates_warm_state(ref_fn, tmp_path):
+    """The acceptance path end-to-end: a single out-of-process replica
+    under supervision builds warm state that overflows into the cold
+    store, is SIGKILLed mid-stream, and the respawned generation serves
+    the resumed sessions token-identically WITH rehydrated cache hits —
+    then drains with zero leaked processes or uncommitted files."""
+    root = str(tmp_path / "coldstore")
+    argv = ["--model", "tiny", "--seed", "0", "--num_blocks", "16",
+            "--max_tokens_per_step", "32", "--max_seqs", "2",
+            "--block_size", "8", "--max_blocks_per_seq", "8",
+            "--enable_prefix_cache", "--kv_host_pool_bytes", "16384",
+            "--kv_coldstore_dir", root]
+    cfg = ServingConfig(num_replicas=1, replica_transport="subprocess",
+                        default_max_tokens=8, max_queue=32,
+                        heartbeat_interval_s=0.2, heartbeat_timeout_s=2.0,
+                        respawn_backoff_s=0.2, respawn_reset_s=1.0,
+                        submit_timeout_s=120.0, spawn_timeout_s=300.0,
+                        failover_wait_s=300.0,
+                        retry_backoff_s=0.02, retry_backoff_max_s=0.5)
+    pool = ReplicaPool.build_subprocess(argv, cfg)
+    pool.start()
+    try:
+        pool.wait_ready()
+        t = pool.replicas[0]
+
+        # warm wave: device pressure (16 blocks, ~3/seq) demotes through
+        # the 16 KiB host pool (<2 blocks) into the cold store
+        for p in FLEET_PROMPTS:
+            h = pool.submit(p, max_new_tokens=8)
+            assert list(h.tokens(timeout=300)) == ref_fn(p, 8)
+        wait_until(lambda: t.prefix_stats().get("coldstore_entries", 0) > 0,
+                   timeout=30.0, msg="cold-store entries in heartbeat")
+
+        # SIGKILL mid-stream: the balancer's failover resubmit waits out
+        # the respawn (failover_wait_s), the respawned generation
+        # rehydrates at boot, and the stream completes token-identical
+        h = pool.submit(FLEET_PROMPTS[0], max_new_tokens=16)
+        it = h.tokens(timeout=600)
+        got = [next(it) for _ in range(3)]
+        gen0 = t.generation
+        t._proc.kill()
+        got += list(it)
+        assert got == ref_fn(FLEET_PROMPTS[0], 16)
+        wait_until(lambda: t.generation > gen0 and t.healthy(),
+                   timeout=300.0, interval=0.2, msg="respawned replica")
+        wait_until(lambda: t.prefix_stats().get("rehydrated_blocks", 0) > 0,
+                   timeout=30.0, msg="rehydrated blocks in heartbeat")
+
+        # resumed sessions: token-identical, served from rehydrated warm
+        # state (prefill actually skipped, not recomputed)
+        for p in FLEET_PROMPTS:
+            h = pool.submit(p, max_new_tokens=8)
+            assert list(h.tokens(timeout=300)) == ref_fn(p, 8)
+        stats = t.prefix_stats()
+        assert stats.get("rehydrated_blocks", 0) > 0
+        assert stats.get("prefill_tokens_skipped", 0) > 0
+    finally:
+        pool.shutdown()
+    for r in pool.replicas:
+        assert r._proc is None or r._proc.poll() is not None
+    # zero leaked serving state: committed entries are the ONLY thing
+    # allowed to outlive the fleet (that is the durability contract) —
+    # no uncommitted staging, no bare spill files
+    for dirpath, dirnames, filenames in os.walk(root):
+        for name in dirnames:
+            assert not name.endswith(".tmp"), os.path.join(dirpath, name)
+        for name in filenames:
+            assert not (name.startswith("kvblock-")
+                        and name.endswith(".safetensors")), \
+                os.path.join(dirpath, name)
+            assert name in ("payload.safetensors", "manifest.json"), \
+                os.path.join(dirpath, name)
